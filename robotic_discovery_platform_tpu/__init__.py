@@ -1,0 +1,37 @@
+"""TPU-native Robotic Discovery Vision Platform.
+
+A brand-new JAX/XLA/Pallas/pjit framework with the capabilities of the
+reference `xuanjiangliu/robotic-discovery-platform` (see /root/repo/SURVEY.md):
+U-Net binary segmentation of soft-robotic actuators, depth -> point-cloud ->
+B-spline -> curvature geometry, a bidirectionally streaming gRPC analysis
+service, and the surrounding MLOps loop (experiment tracking, model registry,
+drift detection, automated retraining) -- all redesigned TPU-first.
+
+Import convention::
+
+    import robotic_discovery_platform_tpu as rdp
+
+Subpackages
+-----------
+- ``models``    Flax U-Net and losses (reference: pkg/segmentation_model.py).
+- ``ops``       jax.numpy geometry engine + Pallas kernels
+                (reference: pkg/geometry_utils.py).
+- ``parallel``  Device meshes, shardings, distributed train steps
+                (new capability; reference is single-device).
+- ``training``  Datasets, synthetic data, optax trainer, orbax checkpoints
+                (reference: scripts/train_segmenter.py).
+- ``tracking``  MLflow-compatible experiment tracking + model registry
+                (reference: mlflow usage in scripts/ and workflows/).
+- ``serving``   gRPC service + client (reference: services/vision_analysis/).
+- ``io``        FrameSource abstraction over cameras / replay / synthetic
+                (reference: pkg/camera.py).
+- ``monitoring`` Drift detection (reference: scripts/monitoring/).
+- ``workflows`` Automated retraining (reference: workflows/).
+- ``tools``     Operator tools: calibration, data collection, dataset build
+                (reference: scripts/01_*.py, scripts/02_*.py).
+- ``utils``     Config dataclasses, logging, profiling.
+"""
+
+from robotic_discovery_platform_tpu.version import __version__
+
+__all__ = ["__version__"]

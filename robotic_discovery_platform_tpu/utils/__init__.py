@@ -1,0 +1,5 @@
+from robotic_discovery_platform_tpu.utils import config
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+from robotic_discovery_platform_tpu.utils.profiling import StageTimer, jax_trace
+
+__all__ = ["config", "get_logger", "StageTimer", "jax_trace"]

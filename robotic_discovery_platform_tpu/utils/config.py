@@ -1,0 +1,301 @@
+"""Dataclass configuration layer.
+
+The reference has no config system at all -- every behavior is a module-level
+constant (reference: scripts/train_segmenter.py:45-63, services/vision_analysis/
+server.py:50-65, services/vision_analysis/client.py:43-45, pkg/camera.py:35,
+scripts/monitoring/drift_detector.py:21-22, scripts/01_calibrate_camera.py:37-38,
+scripts/02_collect_segmentation_data.py:40-42). This module replaces that with
+frozen dataclasses whose *defaults are exactly the reference constants*, plus
+``from_flags`` CLI overrides, so every entry point is configurable without
+editing source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Sequence
+
+
+@dataclass(frozen=True)
+class CameraConfig:
+    """Reference: pkg/camera.py:35 (640x480 @ 30 FPS, depth z16 + color bgr8)."""
+
+    width: int = 640
+    height: int = 480
+    fps: int = 30
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Reference architecture constants: pkg/segmentation_model.py:86-120.
+
+    Channel ladder 64 -> 128 -> 256 -> 512 -> 1024//factor, ``factor == 2``
+    when ``bilinear`` (the deployed default -- the reference instantiates
+    ``UNet(3, 1)`` everywhere: scripts/train_segmenter.py:143).
+    """
+
+    in_channels: int = 3
+    num_classes: int = 1
+    bilinear: bool = True
+    base_features: int = 64
+    # TPU-first knobs (no reference equivalent):
+    compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
+    norm: str = "batch"  # "batch" matches reference; "group" is jit-friendlier
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Reference hyperparameters: scripts/train_segmenter.py:45-50,143-145."""
+
+    learning_rate: float = 1e-4
+    batch_size: int = 4
+    epochs: int = 50
+    validation_split: float = 0.2
+    img_size: int = 256
+    seed: int = 0
+    # Loss: reference uses BCEWithLogitsLoss only (train_segmenter.py:145).
+    # "bce_dice" is the BASELINE.json config-2 variant (Dice+BCE).
+    loss: str = "bce"
+    dice_weight: float = 0.5
+    # MLflow-compatible naming -- byte-compatible with the reference
+    # (train_segmenter.py:61-63): experiment + registered model name.
+    tracking_uri: str = "file:ml/mlruns"
+    experiment_name: str = "Actuator Segmentation"
+    registered_model_name: str = "Actuator-Segmenter"
+    dataset_dir: str = "ml/datasets/processed"
+    checkpoint_dir: str = "ml/checkpoints"
+    keep_checkpoints: int = 3
+    # TPU-first:
+    donate_state: bool = True
+    log_every: int = 1
+
+
+@dataclass(frozen=True)
+class GeometryConfig:
+    """Reference: pkg/geometry_utils.py.
+
+    - 50 x-bins, top 5% by y per bin (:119).
+    - cubic parametric spline, smoothing s=0.1 (:78).
+    - 100 curvature/visualization samples (:83, :146).
+    - graceful-zero cutoffs: <100 cloud points (:64), <20 edge points (:69).
+
+    TPU additions (static-shape budget; no reference equivalent):
+    - ``max_points``: fixed-size point-cloud gather budget.
+    - ``max_per_bin``: fixed top-k budget per bin.
+    - ``num_ctrl``: number of cubic B-spline basis functions for the
+      fixed-knot least-squares fit that replaces FITPACK ``splprep``.
+    """
+
+    num_bins: int = 50
+    top_k_percent: float = 0.05
+    spline_degree: int = 3
+    spline_smoothing: float = 0.1
+    num_samples: int = 100
+    min_cloud_points: int = 100
+    min_edge_points: int = 20
+    max_points: int = 32768
+    max_per_bin: int = 64
+    num_ctrl: int = 16
+    default_depth_scale: float = 0.001  # server.py:59
+
+
+@dataclass(frozen=True)
+class ServerConfig:
+    """Reference: services/vision_analysis/server.py:50-65,161-179."""
+
+    address: str = "[::]:50051"
+    max_workers: int = 10
+    model_img_size: int = 256
+    default_depth_scale: float = 0.001
+    tracking_uri: str = "file:ml/mlruns"
+    model_name: str = "Actuator-Segmenter"
+    # The reference *documents* loading the "staging" alias (README.md:147)
+    # but actually loads "/latest" (server.py:81). We honor the documented
+    # intent: try alias first, fall back to latest. See SURVEY.md section 2.1.
+    model_alias: str = "staging"
+    calibration_path: str = "ml/configs/calibration_data.npz"
+    metrics_csv: str = "logs/vision_service_metrics.csv"
+    metrics_flush_every: int = 32
+    batch_window_ms: float = 0.0  # >0 enables cross-stream micro-batching
+
+
+@dataclass(frozen=True)
+class ClientConfig:
+    """Reference: services/vision_analysis/client.py:43-45."""
+
+    server_address: str = "localhost:50051"
+    calibration_path: str = "ml/configs/calibration_data.npz"
+    smoothing_window: int = 10
+    frame_queue_len: int = 20
+
+
+@dataclass(frozen=True)
+class DriftConfig:
+    """Reference: scripts/monitoring/drift_detector.py:16-22,37."""
+
+    metrics_csv: str = "logs/vision_service_metrics.csv"
+    baseline_fraction: float = 0.5
+    threshold: float = 0.25
+    min_rows: int = 50
+    report_path: str = "reports/drift_report.png"
+    rolling_window: int = 20
+    report_dpi: int = 150
+
+
+@dataclass(frozen=True)
+class CalibrationConfig:
+    """Reference: scripts/01_calibrate_camera.py:37-38,53-55.
+
+    The reference saves to ml/data/ but reads from ml/configs/ (a real path
+    inconsistency, SURVEY.md section 2.1); we unify on ml/configs/.
+    """
+
+    checkerboard_cols: int = 9
+    checkerboard_rows: int = 7
+    square_size_mm: float = 27.0
+    min_captures: int = 5
+    output_path: str = "ml/configs/calibration_data.npz"
+
+
+@dataclass(frozen=True)
+class CollectConfig:
+    """Reference: scripts/02_collect_segmentation_data.py:40-52."""
+
+    output_root: str = "ml/raw_data"
+    capture_interval_s: float = 0.5
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """TPU device-mesh layout (new capability; reference is single-device).
+
+    Axes:
+    - ``data``    data parallel (batch sharding, gradient allreduce over ICI)
+    - ``model``   tensor parallel (channel sharding of wide conv layers)
+    - ``spatial`` spatial/context parallel (H-dimension sharding of activations;
+                  XLA inserts halo exchanges for convs)
+    Zero/negative sizes mean "infer from available devices".
+    """
+
+    data: int = -1
+    model: int = 1
+    spatial: int = 1
+
+
+@dataclass(frozen=True)
+class PlatformConfig:
+    """Root config aggregating every subsystem."""
+
+    camera: CameraConfig = field(default_factory=CameraConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    geometry: GeometryConfig = field(default_factory=GeometryConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
+    client: ClientConfig = field(default_factory=ClientConfig)
+    drift: DriftConfig = field(default_factory=DriftConfig)
+    calibration: CalibrationConfig = field(default_factory=CalibrationConfig)
+    collect: CollectConfig = field(default_factory=CollectConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+def replace(cfg: Any, **updates: Any) -> Any:
+    """`dataclasses.replace` re-export (configs are frozen)."""
+    return dataclasses.replace(cfg, **updates)
+
+
+def to_dict(cfg: Any) -> dict:
+    return dataclasses.asdict(cfg)
+
+
+def to_json(cfg: Any) -> str:
+    return json.dumps(to_dict(cfg), indent=2, sort_keys=True)
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+def from_dict(cls: type, data: dict) -> Any:
+    """Rebuild a (possibly nested) config dataclass from a plain dict."""
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(
+            f"unknown config keys for {cls.__name__}: {sorted(unknown)}"
+        )
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        if isinstance(v, dict) and dataclasses.is_dataclass(_resolve(f)):
+            kwargs[f.name] = from_dict(_resolve(f), v)
+        else:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def _resolve(f: dataclasses.Field) -> type:
+    t = f.type
+    if isinstance(t, str):
+        # PEP 563 stringified annotations: look up builtins first, then this
+        # module (for nested config classes).
+        import builtins
+
+        resolved = getattr(builtins, t, None) or globals().get(t)
+        if resolved is None:
+            raise TypeError(
+                f"config field {f.name!r} has unresolvable annotation {t!r}; "
+                "use a builtin or a config class defined in this module"
+            )
+        t = resolved
+    return t
+
+
+def add_flags(parser: argparse.ArgumentParser, cls: type, prefix: str = "") -> None:
+    """Register ``--section.field`` flags for every leaf of a config tree."""
+    for f in dataclasses.fields(cls):
+        t = _resolve(f)
+        name = f"{prefix}{f.name}"
+        if dataclasses.is_dataclass(t):
+            add_flags(parser, t, prefix=f"{name}.")
+        else:
+            parser.add_argument(f"--{name}", type=str, default=None, help=f"({t.__name__})")
+
+
+def apply_flags(cfg: Any, args: argparse.Namespace) -> Any:
+    """Apply parsed ``--section.field`` overrides onto a frozen config tree."""
+
+    def _apply(node: Any, prefix: str) -> Any:
+        updates = {}
+        for f in dataclasses.fields(node):
+            t = _resolve(f)
+            name = f"{prefix}{f.name}"
+            if dataclasses.is_dataclass(t):
+                updates[f.name] = _apply(getattr(node, f.name), f"{name}.")
+            else:
+                raw = getattr(args, name, None)
+                if raw is not None:
+                    updates[f.name] = _coerce(raw, t)
+        return dataclasses.replace(node, **updates)
+
+    return _apply(cfg, "")
+
+
+def parse_config(argv: Sequence[str] | None = None,
+                 cls: type = PlatformConfig) -> Any:
+    """Build a config from defaults + optional JSON file + CLI overrides."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, default=None, help="JSON config file")
+    add_flags(parser, cls)
+    args = parser.parse_args(argv)
+    cfg = cls()
+    if args.config:
+        cfg = from_dict(cls, json.loads(Path(args.config).read_text()))
+    return apply_flags(cfg, args)

@@ -1,0 +1,21 @@
+"""Uniform logging setup.
+
+Every reference entry point repeats the same ``logging.basicConfig`` idiom
+(server.py:56, client.py:78, train_segmenter.py:107, retraining_pipeline.py:46,
+drift_detector.py:28, 01_calibrate_camera.py:39); here it is once.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+
+
+def get_logger(name: str, level: int = logging.INFO) -> logging.Logger:
+    root = logging.getLogger()
+    if not root.handlers:
+        logging.basicConfig(level=level, format=_FORMAT)
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    return logger

@@ -1,0 +1,63 @@
+"""Tracing / profiling helpers.
+
+The reference reserves a ``proc_time_ms`` wire field but never measures
+anything (protos/vision.proto:34 vs services/vision_analysis/server.py:135-152)
+and ships no profiler integration. Here both exist: lightweight host-side
+stage timers (feeding ``proc_time_ms`` for real) and ``jax.profiler`` trace
+capture around compiled steps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class StageTimer:
+    """Accumulates wall-clock per named stage; thread-compatible enough for
+    per-stream use (each gRPC stream owns its own timer)."""
+
+    totals: dict = field(default_factory=lambda: defaultdict(float))
+    counts: dict = field(default_factory=lambda: defaultdict(int))
+    last: dict = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] += dt
+            self.counts[name] += 1
+            self.last[name] = dt
+
+    def last_ms(self, *names: str) -> float:
+        return 1e3 * sum(self.last.get(n, 0.0) for n in names)
+
+    def mean_ms(self, name: str) -> float:
+        c = self.counts.get(name, 0)
+        return 1e3 * self.totals[name] / c if c else 0.0
+
+    def summary(self) -> dict:
+        return {n: {"mean_ms": self.mean_ms(n), "count": self.counts[n]}
+                for n in self.totals}
+
+
+@contextlib.contextmanager
+def jax_trace(log_dir: str | None):
+    """Capture a ``jax.profiler`` trace (TensorBoard-viewable) when ``log_dir``
+    is set; no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU backend before jax initializes.
+
+This is the standard JAX idiom for exercising multi-chip pjit/shard_map code
+paths in CI without TPU hardware (SURVEY.md section 4): the same meshes and
+collectives compile and run against N virtual CPU devices.
+"""
+
+import os
+
+# Must run before the first `import jax` anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
