@@ -1,19 +1,28 @@
-"""Test harness: force an 8-device virtual CPU backend before jax initializes.
+"""Test harness: force an 8-device virtual CPU backend.
 
 This is the standard JAX idiom for exercising multi-chip pjit/shard_map code
 paths in CI without TPU hardware (SURVEY.md section 4): the same meshes and
 collectives compile and run against N virtual CPU devices.
+
+Note: this image's axon sitecustomize force-registers the tunneled TPU
+backend and rewrites ``jax_platforms`` at interpreter start, so the env var
+alone is not enough -- we also update the config after importing jax.
 """
 
 import os
 
 # Must run before the first `import jax` anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,86 @@
+"""Test-only numpy/scipy oracle with the *reference semantics* of the
+curvature pipeline (spec: SURVEY.md section 2.1 "Geometry engine", i.e.
+/root/reference/pkg/geometry_utils.py). Written fresh from that spec purely
+as a comparison target for the jax implementation; not part of the package.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.interpolate import splev, splprep
+
+
+def oracle_curvature(mask, depth, intrinsics, depth_scale,
+                     num_bins=50, top_pct=0.05, s=0.1, n_samples=100):
+    """Returns (mean_k, max_k, spline_pts[n,3]) or (0, 0, empty)."""
+    empty = (0.0, 0.0, np.zeros((0, 3)))
+    vv, uu = np.nonzero(mask > 0)
+    zz = depth[vv, uu].astype(np.float64) * depth_scale
+    keep = zz > 0
+    vv, uu, zz = vv[keep], uu[keep], zz[keep]
+    if len(zz) < 100:
+        return empty
+    fx, fy, cx, cy = (intrinsics[0, 0], intrinsics[1, 1],
+                      intrinsics[0, 2], intrinsics[1, 2])
+    cloud = np.column_stack([(uu - cx) * zz / fx, (vv - cy) * zz / fy, zz])
+
+    # top edge: per x-bin, keep the max(1, floor(5% * n)) largest-y points
+    if len(cloud) < num_bins:
+        return empty
+    lo, hi = cloud[:, 0].min(), cloud[:, 0].max()
+    width = (hi - lo) / num_bins
+    if width <= 0:
+        return empty
+    which = np.clip(((cloud[:, 0] - lo) // width).astype(int), 0, num_bins - 1)
+    chunks = []
+    for b in range(num_bins):
+        grp = cloud[which == b]
+        if len(grp):
+            k = max(1, int(len(grp) * top_pct))
+            chunks.append(grp[np.argsort(grp[:, 1])[-k:]])
+    edge = np.concatenate(chunks) if chunks else np.zeros((0, 3))
+    if len(edge) < 20:
+        return empty
+
+    edge = edge[np.argsort(edge[:, 0])]
+    try:
+        tck, _ = splprep(list(edge.T), s=s, k=3)
+    except (TypeError, ValueError):
+        return empty
+    t = np.linspace(0, 1, n_samples)
+    d1 = np.asarray(splev(t, tck, der=1)).T
+    d2 = np.asarray(splev(t, tck, der=2)).T
+    num = np.linalg.norm(np.cross(d1, d2), axis=1)
+    den = np.linalg.norm(d1, axis=1)
+    ok = den > 1e-6
+    if not ok.any():
+        return empty
+    kappa = num[ok] / den[ok] ** 3
+    pts = np.asarray(splev(t, tck)).T
+    return float(kappa.mean()), float(kappa.max()), pts
+
+
+def make_arc_scene(h=480, w=640, f=600.0, z0=0.5, r_px=300.0,
+                   band_px=80, cx=None, cy=None, arc_cy_px=80.0):
+    """Synthetic scene whose *bottom* image boundary (the largest-y edge in
+    camera coordinates) is a circular arc of known 3D radius.
+
+    With fx == fy == f and constant depth z0, pixel-space curves map to 3D by
+    a pure scale z0/f, so a pixel circle of radius r_px becomes a 3D circle of
+    radius R = r_px * z0 / f -> ground-truth curvature f / (r_px * z0).
+
+    Returns (mask uint8 [h,w], depth uint16 [h,w], intrinsics [3,3],
+    depth_scale, true_curvature).
+    """
+    cx = w / 2 if cx is None else cx
+    cy = h / 2 if cy is None else cy
+    uu, vv = np.meshgrid(np.arange(w), np.arange(h))
+    # lower half-circle bulging downward, centered above the image
+    inside = (uu - w / 2) ** 2 <= r_px ** 2 * 0.9  # keep away from verticals
+    v_edge = arc_cy_px + np.sqrt(np.maximum(r_px ** 2 - (uu - w / 2) ** 2, 0.0))
+    mask = (inside & (vv <= v_edge) & (vv >= v_edge - band_px)).astype(np.uint8)
+    depth_scale = 0.001
+    depth = np.full((h, w), int(z0 / depth_scale), dtype=np.uint16)
+    k = np.array([[f, 0, cx], [0, f, cy], [0, 0, 1]], dtype=np.float64)
+    true_curv = f / (r_px * z0)
+    return mask, depth, k, depth_scale, true_curv

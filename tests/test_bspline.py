@@ -1,0 +1,100 @@
+"""Unit tests for the fixed-knot B-spline engine against scipy ground truth."""
+
+import numpy as np
+import pytest
+from scipy.interpolate import BSpline
+
+import jax.numpy as jnp
+
+from robotic_discovery_platform_tpu.ops import bspline
+
+
+DEGREE = 3
+
+
+def _scipy_design_matrix(u, knots, degree):
+    n_ctrl = len(knots) - degree - 1
+    cols = []
+    for i in range(n_ctrl):
+        c = np.zeros(n_ctrl)
+        c[i] = 1.0
+        spl = BSpline(knots, c, degree, extrapolate=False)
+        col = spl(u)
+        cols.append(np.nan_to_num(col))
+    return np.column_stack(cols)
+
+
+@pytest.mark.parametrize("num_ctrl", [4, 8, 16])
+def test_basis_matches_scipy(num_ctrl):
+    knots = bspline.clamped_uniform_knots(num_ctrl, DEGREE)
+    u = np.linspace(0, 1, 97)
+    ours = np.asarray(bspline.bspline_basis(jnp.asarray(u), knots, DEGREE))
+    theirs = _scipy_design_matrix(u, knots, DEGREE)
+    # scipy's extrapolate=False zeroes u=1 in the last basis fn; fix endpoint
+    theirs[-1, -1] = 1.0
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+def test_partition_of_unity():
+    knots = bspline.clamped_uniform_knots(12, DEGREE)
+    u = np.linspace(0, 1, 513)
+    b = np.asarray(bspline.bspline_basis(jnp.asarray(u), knots, DEGREE))
+    np.testing.assert_allclose(b.sum(axis=1), 1.0, atol=1e-6)
+    assert (b >= -1e-9).all()
+
+
+@pytest.mark.parametrize("order", [1, 2])
+def test_derivative_matches_scipy(order):
+    num_ctrl = 10
+    knots = bspline.clamped_uniform_knots(num_ctrl, DEGREE)
+    rng = np.random.default_rng(1)
+    ctrl = rng.normal(size=(num_ctrl, 3))
+    u = np.linspace(0.01, 0.99, 51)  # avoid endpoint derivative conventions
+    ours = np.asarray(
+        bspline.evaluate_bspline(jnp.asarray(ctrl), knots, jnp.asarray(u), DEGREE, order)
+    )
+    spl = BSpline(knots, ctrl, DEGREE)
+    theirs = spl.derivative(order)(u)
+    # f32 roundoff amplified by the derivative scale (~d/dt ~ 20 per order)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-3)
+
+
+def test_fit_reconstructs_smooth_curve():
+    t = np.linspace(0, 1, 400)
+    pts = np.stack([t, np.sin(2 * np.pi * t) * 0.1, 0.5 + 0.05 * t], axis=1)
+    w = np.ones(len(t))
+    knots = bspline.clamped_uniform_knots(16, DEGREE)
+    ctrl, u = bspline.fit_bspline(
+        jnp.asarray(pts), jnp.asarray(w), knots, DEGREE, smoothing=1e-6
+    )
+    recon = np.asarray(bspline.evaluate_bspline(ctrl, knots, u, DEGREE))
+    assert np.abs(recon - pts).max() < 5e-3
+
+
+def test_fit_ignores_padded_points():
+    t = np.linspace(0, 1, 200)
+    pts = np.stack([t, t ** 2, np.zeros_like(t)], axis=1)
+    pad = np.full((100, 3), 777.0)  # garbage padding
+    all_pts = np.concatenate([pts, pad])
+    w = np.concatenate([np.ones(200), np.zeros(100)])
+    knots = bspline.clamped_uniform_knots(12, DEGREE)
+    ctrl, u = bspline.fit_bspline(
+        jnp.asarray(all_pts), jnp.asarray(w), knots, DEGREE, smoothing=1e-6
+    )
+    recon = np.asarray(bspline.evaluate_bspline(ctrl, knots, u[:200], DEGREE))
+    assert np.abs(recon - pts).max() < 1e-2
+
+
+def test_circle_curvature():
+    r = 0.25
+    theta = np.linspace(0.3, np.pi - 0.3, 300)
+    pts = np.stack([r * np.cos(theta), r * np.sin(theta), np.zeros_like(theta)], axis=1)
+    w = np.ones(len(theta))
+    knots = bspline.clamped_uniform_knots(16, DEGREE)
+    ctrl, _ = bspline.fit_bspline(
+        jnp.asarray(pts), jnp.asarray(w), knots, DEGREE, smoothing=1e-6
+    )
+    u = jnp.linspace(0.05, 0.95, 100)
+    kappa, valid, _ = bspline.curvature_profile(ctrl, knots, u, DEGREE)
+    kappa = np.asarray(kappa)[np.asarray(valid)]
+    np.testing.assert_allclose(kappa.mean(), 1.0 / r, rtol=0.02)

@@ -1,0 +1,114 @@
+"""Geometry pipeline tests: analytic arcs, reference-semantics oracle parity,
+graceful-zero behavior, and jit-compilability."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from robotic_discovery_platform_tpu.ops import geometry
+from robotic_discovery_platform_tpu.utils.config import GeometryConfig
+
+from oracle import make_arc_scene, oracle_curvature
+
+
+def test_deproject_matches_pinhole():
+    h, w = 48, 64
+    depth = np.full((h, w), 500, np.uint16)
+    mask = np.ones((h, w), np.uint8)
+    fx = fy = 100.0
+    cx, cy = 32.0, 24.0
+    x, y, z, valid = geometry.deproject(
+        jnp.asarray(mask), jnp.asarray(depth), fx, fy, cx, cy, 0.001
+    )
+    assert bool(valid.all())
+    np.testing.assert_allclose(float(z[0, 0]), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(x[0, 0]), (0 - cx) * 0.5 / fx, rtol=1e-5)
+    np.testing.assert_allclose(float(y[10, 3]), (10 - cy) * 0.5 / fy, rtol=1e-5)
+
+
+def test_arc_scene_curvature_close_to_analytic():
+    mask, depth, k, scale, true_k = make_arc_scene()
+    prof = geometry.compute_curvature_profile(
+        jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), scale
+    )
+    assert bool(prof.valid)
+    mean_k = float(prof.mean_curvature)
+    assert abs(mean_k - true_k) / true_k < 0.15, (mean_k, true_k)
+
+
+def test_matches_reference_oracle_on_arc():
+    mask, depth, k, scale, _ = make_arc_scene(r_px=260.0, band_px=60)
+    om, ox, _ = oracle_curvature(mask, depth, k, scale)
+    prof = geometry.compute_curvature_profile(
+        jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), scale
+    )
+    assert bool(prof.valid) and om > 0
+    ours_m = float(prof.mean_curvature)
+    ours_x = float(prof.max_curvature)
+    assert abs(ours_m - om) / om < 0.2, (ours_m, om)
+    assert abs(ours_x - ox) / max(ox, 1e-9) < 0.5, (ours_x, ox)
+
+
+def test_empty_mask_graceful_zero():
+    mask = np.zeros((480, 640), np.uint8)
+    depth = np.full((480, 640), 500, np.uint16)
+    k = np.array([[600.0, 0, 320], [0, 600.0, 240], [0, 0, 1]])
+    prof = geometry.compute_curvature_profile(
+        jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), 0.001
+    )
+    assert not bool(prof.valid)
+    assert float(prof.mean_curvature) == 0.0
+    assert float(prof.max_curvature) == 0.0
+    assert np.asarray(prof.spline_points).sum() == 0.0
+
+
+def test_tiny_mask_graceful_zero():
+    mask = np.zeros((480, 640), np.uint8)
+    mask[200:205, 300:310] = 1  # 50 px < min_cloud_points=100
+    depth = np.full((480, 640), 500, np.uint16)
+    k = np.array([[600.0, 0, 320], [0, 600.0, 240], [0, 0, 1]])
+    prof = geometry.compute_curvature_profile(
+        jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), 0.001
+    )
+    assert not bool(prof.valid)
+
+
+def test_zero_depth_excluded():
+    mask, depth, k, scale, _ = make_arc_scene()
+    depth2 = depth.copy()
+    depth2[:, :] = 0  # all invalid depth -> no cloud
+    prof = geometry.compute_curvature_profile(
+        jnp.asarray(mask), jnp.asarray(depth2), jnp.asarray(k), scale
+    )
+    assert not bool(prof.valid)
+
+
+def test_single_column_mask_graceful_zero():
+    """Zero x-range -> bin_width 0 -> invalid (reference :127-128)."""
+    mask = np.zeros((480, 640), np.uint8)
+    mask[100:400, 320] = 1  # 300 points, one column
+    depth = np.full((480, 640), 500, np.uint16)
+    k = np.array([[600.0, 0, 320], [0, 600.0, 240], [0, 0, 1]])
+    prof = geometry.compute_curvature_profile(
+        jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), 0.001
+    )
+    assert not bool(prof.valid)
+
+
+def test_jitted_profile_compiles_and_reruns():
+    mask, depth, k, scale, true_k = make_arc_scene()
+    fn = geometry.make_jitted_profile()
+    p1 = fn(jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), scale)
+    p2 = fn(jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), scale)
+    assert bool(p1.valid) and bool(p2.valid)
+    assert float(p1.mean_curvature) == float(p2.mean_curvature)
+
+
+def test_profile_shapes_are_static():
+    cfg = GeometryConfig()
+    mask, depth, k, scale, _ = make_arc_scene()
+    prof = geometry.compute_curvature_profile(
+        jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), scale, cfg
+    )
+    assert prof.spline_points.shape == (cfg.num_samples, 3)
+    assert prof.mean_curvature.shape == ()
