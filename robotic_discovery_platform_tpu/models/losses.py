@@ -1,0 +1,97 @@
+"""Segmentation losses and evaluation metrics.
+
+The reference trains with ``BCEWithLogitsLoss`` only and never computes any
+overlap metric (reference: scripts/train_segmenter.py:145; SURVEY.md section
+2.1 "no accuracy/IoU/Dice anywhere"). Capability parity keeps BCE as the
+default loss; the Dice term (BASELINE.json config 2) and the IoU/Dice/accuracy
+metrics are new -- they exist precisely because the rebuild must demonstrate
+"equal mIoU" against a baseline that never measured it.
+
+All functions are pure jax.numpy on logits/labels of shape [..., H, W, C].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def bce_with_logits(logits, labels):
+    """Mean binary cross-entropy on logits (numerically stable form:
+    max(x,0) - x*z + log1p(exp(-|x|)), the same formulation torch uses)."""
+    x, z = logits, labels.astype(logits.dtype)
+    per = jnp.maximum(x, 0.0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return jnp.mean(per)
+
+
+def dice_loss(logits, labels, eps: float = 1.0):
+    """Soft Dice loss (1 - Dice coefficient on sigmoid probabilities)."""
+    p = jax.nn.sigmoid(logits)
+    z = labels.astype(logits.dtype)
+    axes = (-3, -2, -1)  # per-sample reduce over H, W, C; mean over leading dims
+    inter = jnp.sum(p * z, axis=axes)
+    denom = jnp.sum(p, axis=axes) + jnp.sum(z, axis=axes)
+    dice = (2.0 * inter + eps) / (denom + eps)
+    return jnp.mean(1.0 - dice)
+
+
+def bce_dice(logits, labels, dice_weight: float = 0.5):
+    return (1.0 - dice_weight) * bce_with_logits(logits, labels) + (
+        dice_weight
+    ) * dice_loss(logits, labels)
+
+
+def make_loss_fn(name: str, dice_weight: float = 0.5):
+    if name == "bce":
+        return bce_with_logits
+    if name == "dice":
+        return dice_loss
+    if name == "bce_dice":
+        return lambda lg, lb: bce_dice(lg, lb, dice_weight)
+    raise ValueError(f"unknown loss {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# Metrics (hard masks at threshold 0.5, matching the serving threshold --
+# reference: services/vision_analysis/server.py:124)
+# ---------------------------------------------------------------------------
+
+
+def binary_iou(logits, labels, threshold: float = 0.5, eps: float = 1e-7):
+    """Foreground IoU per batch, scalar mean."""
+    pred = jax.nn.sigmoid(logits) > threshold
+    z = labels > 0.5
+    axes = (-3, -2, -1)
+    inter = jnp.sum(pred & z, axis=axes).astype(jnp.float32)
+    union = jnp.sum(pred | z, axis=axes).astype(jnp.float32)
+    return jnp.mean((inter + eps) / (union + eps))
+
+
+def mean_iou(logits, labels, threshold: float = 0.5, eps: float = 1e-7):
+    """mIoU over {background, foreground} -- the parity metric
+    (BASELINE.md: 'equal mIoU')."""
+    pred = jax.nn.sigmoid(logits) > threshold
+    z = labels > 0.5
+    axes = (-3, -2, -1)
+
+    def iou(a, b):
+        inter = jnp.sum(a & b, axis=axes).astype(jnp.float32)
+        union = jnp.sum(a | b, axis=axes).astype(jnp.float32)
+        return (inter + eps) / (union + eps)
+
+    return jnp.mean(0.5 * (iou(pred, z) + iou(~pred, ~z)))
+
+
+def dice_coefficient(logits, labels, threshold: float = 0.5, eps: float = 1e-7):
+    pred = jax.nn.sigmoid(logits) > threshold
+    z = labels > 0.5
+    axes = (-3, -2, -1)
+    inter = jnp.sum(pred & z, axis=axes).astype(jnp.float32)
+    total = jnp.sum(pred, axis=axes) + jnp.sum(z, axis=axes)
+    return jnp.mean((2.0 * inter + eps) / (total.astype(jnp.float32) + eps))
+
+
+def pixel_accuracy(logits, labels, threshold: float = 0.5):
+    pred = jax.nn.sigmoid(logits) > threshold
+    z = labels > 0.5
+    return jnp.mean((pred == z).astype(jnp.float32))

@@ -1,0 +1,162 @@
+"""Flax U-Net for binary actuator segmentation, TPU-first.
+
+Same architecture family as the reference PyTorch model
+(reference: pkg/segmentation_model.py:24-120): DoubleConv blocks of
+(3x3 conv, no bias -> norm -> ReLU) x 2, a 4-level encoder with 2x2
+max-pooling, a decoder with bilinear upsampling (default) or transposed
+convolution, pad-free skip fusion, and a 1x1 output head. Channel ladder
+64 -> 128 -> 256 -> 512 -> 1024//factor with factor = 2 when bilinear
+(the deployed configuration -- the reference instantiates ``UNet(3, 1)``
+everywhere, e.g. scripts/train_segmenter.py:143).
+
+TPU-first design departures (deliberate, not omissions):
+- **NHWC layout** -- the native layout for XLA TPU convolutions (the
+  reference is NCHW because cuDNN prefers it).
+- **bfloat16 compute, float32 params** via ``dtype``/``param_dtype`` so
+  convs hit the MXU at full rate; the output head is cast back to f32.
+- **Resize-to-skip upsampling**: instead of the reference's pad-then-concat
+  (segmentation_model.py:67-76) the decoder resizes the upsampled feature
+  map directly to the skip's spatial shape -- identical result for even
+  sizes, and shape-safe for odd sizes without dynamic padding.
+- Optional **GroupNorm** (``norm="group"``) as a batch-size-independent
+  alternative to BatchNorm for small per-device batches under data
+  parallelism; ``norm="batch"`` matches the reference semantics and is the
+  default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+DType = Any
+
+
+def _norm(norm: str, dtype: DType, train: bool, features: int):
+    if norm == "batch":
+        return nn.BatchNorm(use_running_average=not train, dtype=dtype)
+    if norm == "group":
+        import math
+
+        return nn.GroupNorm(num_groups=math.gcd(32, features), dtype=dtype)
+    raise ValueError(f"unknown norm {norm!r}")
+
+
+class DoubleConv(nn.Module):
+    """(3x3 conv no-bias -> norm -> ReLU) x 2
+    (reference: pkg/segmentation_model.py:24-40)."""
+
+    features: int
+    mid_features: int | None = None
+    norm: str = "batch"
+    dtype: DType = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        mid = self.mid_features or self.features
+        x = nn.Conv(mid, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = _norm(self.norm, self.dtype, train, mid)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = _norm(self.norm, self.dtype, train, self.features)(x)
+        return nn.relu(x)
+
+
+class Down(nn.Module):
+    """2x2 max-pool then DoubleConv (reference: pkg/segmentation_model.py:42-52)."""
+
+    features: int
+    norm: str = "batch"
+    dtype: DType = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return DoubleConv(self.features, norm=self.norm, dtype=self.dtype)(x, train)
+
+
+class Up(nn.Module):
+    """Upsample, fuse with the skip, DoubleConv
+    (reference: pkg/segmentation_model.py:54-76).
+
+    ``bilinear=True`` (deployed default) resizes by interpolation and gives
+    the DoubleConv a halved mid-channel width; otherwise a 2x2 stride-2
+    transposed conv halves the channel count before fusion.
+    """
+
+    features: int
+    bilinear: bool = True
+    norm: str = "batch"
+    dtype: DType = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, skip, train: bool = False):
+        b, h, w, c = skip.shape
+        if self.bilinear:
+            x = jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="bilinear")
+            mid = (x.shape[3] + c) // 2
+            x = jnp.concatenate([skip, x.astype(skip.dtype)], axis=-1)
+            return DoubleConv(self.features, mid_features=mid,
+                              norm=self.norm, dtype=self.dtype)(x, train)
+        x = nn.ConvTranspose(x.shape[3] // 2, (2, 2), strides=(2, 2), dtype=self.dtype)(x)
+        x = jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="nearest")
+        x = jnp.concatenate([skip, x.astype(skip.dtype)], axis=-1)
+        return DoubleConv(self.features, norm=self.norm, dtype=self.dtype)(x, train)
+
+
+class UNet(nn.Module):
+    """Encoder/decoder U-Net (reference: pkg/segmentation_model.py:86-120).
+
+    Call with NHWC input; returns NHWC logits in float32.
+    """
+
+    num_classes: int = 1
+    base_features: int = 64
+    bilinear: bool = True
+    norm: str = "batch"
+    dtype: DType = jnp.bfloat16
+    in_features: int = 3  # used by init helpers; convs infer from input
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        f = self.base_features
+        factor = 2 if self.bilinear else 1
+        x = x.astype(self.dtype)
+        x1 = DoubleConv(f, norm=self.norm, dtype=self.dtype)(x, train)
+        x2 = Down(f * 2, norm=self.norm, dtype=self.dtype)(x1, train)
+        x3 = Down(f * 4, norm=self.norm, dtype=self.dtype)(x2, train)
+        x4 = Down(f * 8, norm=self.norm, dtype=self.dtype)(x3, train)
+        x5 = Down(f * 16 // factor, norm=self.norm, dtype=self.dtype)(x4, train)
+        y = Up(f * 8 // factor, self.bilinear, self.norm, self.dtype)(x5, x4, train)
+        y = Up(f * 4 // factor, self.bilinear, self.norm, self.dtype)(y, x3, train)
+        y = Up(f * 2 // factor, self.bilinear, self.norm, self.dtype)(y, x2, train)
+        y = Up(f, self.bilinear, self.norm, self.dtype)(y, x1, train)
+        logits = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype)(y)
+        return logits.astype(jnp.float32)
+
+
+def build_unet(cfg: ModelConfig = ModelConfig()) -> UNet:
+    return UNet(
+        num_classes=cfg.num_classes,
+        base_features=cfg.base_features,
+        bilinear=cfg.bilinear,
+        norm=cfg.norm,
+        dtype=jnp.dtype(cfg.compute_dtype),
+        in_features=cfg.in_channels,
+    )
+
+
+def init_unet(model: UNet, rng, img_size: int = 256):
+    """Initialize variables with a dummy batch; returns the variable dict
+    (``params`` + ``batch_stats`` when BatchNorm is used)."""
+    dummy = jnp.zeros((1, img_size, img_size, model.in_features), jnp.float32)
+    return model.init(rng, dummy, train=False)
+
+
+def param_count(variables) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(variables.get("params", variables)))

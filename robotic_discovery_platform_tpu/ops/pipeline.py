@@ -1,0 +1,126 @@
+"""The fused per-frame analysis graph: frame -> mask -> curvature in ONE
+jitted XLA computation.
+
+This is the BASELINE.json north star ("mask+curvature run in one XLA graph
+per frame"). The reference executes the same logic as five separate host
+steps with two host<->device transfers (reference: services/vision_analysis/
+server.py:117-133 -- torchvision preprocess, torch forward, sigmoid/threshold,
+cv2 nearest-resize back to native, numpy/scipy geometry). Here a single
+compiled function takes the raw uint8 RGB frame + raw z16 depth and returns
+the native-resolution mask, curvature profile, and coverage -- the only host
+work left is image decode and protobuf.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from robotic_discovery_platform_tpu.ops import geometry
+from robotic_discovery_platform_tpu.utils.config import GeometryConfig
+
+
+class FrameAnalysis(NamedTuple):
+    mask: jnp.ndarray  # [(B,) H, W] uint8 native-resolution binary mask
+    mask_coverage: jnp.ndarray  # [(B,)] percent of frame covered
+    profile: geometry.CurvatureProfile  # leaves have a leading B in batch mode
+
+
+def preprocess(frames_rgb, img_size: int):
+    """uint8 [B, H, W, 3] RGB -> float [B, S, S, 3] in [0, 1].
+
+    Mirrors the reference's ToTensor + Resize(256, antialias) preprocess
+    (reference: services/vision_analysis/server.py:107-121), but inside the
+    graph: scale first, then antialiased bilinear resize.
+    """
+    b = frames_rgb.shape[0]
+    x = frames_rgb.astype(jnp.float32) / 255.0
+    return jax.image.resize(
+        x, (b, img_size, img_size, 3), method="bilinear", antialias=True
+    )
+
+
+def logits_to_native_masks(logits, h: int, w: int, threshold: float = 0.5):
+    """sigmoid > threshold at model resolution, nearest-resize to native
+    [B, H, W] (reference: server.py:122-125)."""
+    prob = jax.nn.sigmoid(logits[..., 0])
+    masks = (prob > threshold).astype(jnp.uint8)
+    return jax.image.resize(masks, (masks.shape[0], h, w), method="nearest")
+
+
+def _analyze_batch(model, variables, frames_rgb, depths, intrinsics,
+                   depth_scales, img_size, geom_cfg, threshold):
+    """Shared core: [B, ...] frames -> FrameAnalysis with leading B."""
+    b, h, w = frames_rgb.shape[0], frames_rgb.shape[1], frames_rgb.shape[2]
+    x = preprocess(frames_rgb, img_size)
+    logits = model.apply(variables, x, train=False)
+    masks = logits_to_native_masks(logits, h, w, threshold)
+
+    def per_frame(mask, depth, k, scale):
+        return geometry.compute_curvature_profile(mask, depth, k, scale, geom_cfg)
+
+    profs = jax.vmap(per_frame)(masks, depths, intrinsics, depth_scales)
+    coverage = 100.0 * jnp.mean(masks.astype(jnp.float32), axis=(1, 2))
+    return FrameAnalysis(mask=masks, mask_coverage=coverage, profile=profs)
+
+
+def make_frame_analyzer(
+    model,
+    img_size: int = 256,
+    geom_cfg: GeometryConfig = GeometryConfig(),
+    threshold: float = 0.5,
+):
+    """Build the jitted single-frame fused analyzer.
+
+    Returns ``analyze(variables, frame_rgb_u8 [H,W,3], depth_u16 [H,W],
+    intrinsics [3,3], depth_scale) -> FrameAnalysis`` (unbatched outputs).
+    Shapes are static per (H, W); jit caches one executable per camera
+    geometry.
+    """
+
+    @jax.jit
+    def analyze(variables, frame_rgb, depth, intrinsics, depth_scale):
+        out = _analyze_batch(
+            model,
+            variables,
+            frame_rgb[None],
+            depth[None],
+            jnp.asarray(intrinsics, jnp.float32)[None],
+            jnp.asarray(depth_scale, jnp.float32)[None],
+            img_size,
+            geom_cfg,
+            threshold,
+        )
+        return jax.tree.map(lambda a: a[0], out)
+
+    return analyze
+
+
+def make_batch_analyzer(
+    model,
+    img_size: int = 256,
+    geom_cfg: GeometryConfig = GeometryConfig(),
+    threshold: float = 0.5,
+):
+    """Batched variant for cross-stream micro-batching on one chip: one
+    forward pass over [B, H, W, 3], geometry vmapped per frame. The model
+    forward is where the MXU time goes, so batching concurrent gRPC streams
+    into one dispatch is the single biggest serving-throughput lever
+    (SURVEY.md section 5.7b).
+
+    ``intrinsics`` is [B, 3, 3] and ``depth_scales`` is [B] so streams from
+    different cameras batch correctly.
+    """
+
+    @jax.jit
+    def analyze(variables, frames_rgb, depths, intrinsics, depth_scales):
+        return _analyze_batch(
+            model, variables, frames_rgb, depths,
+            jnp.asarray(intrinsics, jnp.float32),
+            jnp.asarray(depth_scales, jnp.float32),
+            img_size, geom_cfg, threshold,
+        )
+
+    return analyze

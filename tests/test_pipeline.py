@@ -1,0 +1,72 @@
+"""Fused frame-analysis graph tests."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from robotic_discovery_platform_tpu.models.unet import UNet
+from robotic_discovery_platform_tpu.ops import pipeline
+from robotic_discovery_platform_tpu.utils.config import GeometryConfig
+
+from oracle import make_arc_scene
+
+
+def _small_model_and_vars():
+    model = UNet(base_features=8, dtype=jnp.float32)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False
+    )
+    return model, variables
+
+
+def test_fused_analyzer_runs_end_to_end():
+    model, variables = _small_model_and_vars()
+    mask, depth, k, scale, _ = make_arc_scene(h=120, w=160, r_px=70.0, band_px=30)
+    frame = np.dstack([mask * 200] * 3).astype(np.uint8)
+    analyze = pipeline.make_frame_analyzer(model, img_size=64)
+    out = analyze(variables, jnp.asarray(frame), jnp.asarray(depth), jnp.asarray(k), scale)
+    assert out.mask.shape == (120, 160)
+    assert out.mask.dtype == jnp.uint8
+    assert 0.0 <= float(out.mask_coverage) <= 100.0
+    assert out.profile.spline_points.shape == (GeometryConfig().num_samples, 3)
+
+
+def test_fused_analyzer_perfect_mask_recovers_curvature():
+    """Bypass model uncertainty: a 'model' whose logits reproduce the scene
+    mask must yield the analytic curvature through the full fused graph."""
+    mask, depth, k, scale, true_k = make_arc_scene()
+
+    class Oracle:
+        def apply(self, variables, x, train=False):
+            # x: [1, S, S, 3] resized frame in [0,1]; recover mask from it
+            return jnp.where(x[..., :1] > 0.3, 20.0, -20.0)
+
+    analyze = pipeline.make_frame_analyzer(Oracle(), img_size=256)
+    frame = np.dstack([mask * 255] * 3).astype(np.uint8)
+    out = analyze({}, jnp.asarray(frame), jnp.asarray(depth), jnp.asarray(k), scale)
+    assert bool(out.profile.valid)
+    got = float(out.profile.mean_curvature)
+    assert abs(got - true_k) / true_k < 0.2, (got, true_k)
+    # coverage should be close to the scene's own coverage
+    np.testing.assert_allclose(
+        float(out.mask_coverage), 100.0 * mask.mean(), atol=1.5
+    )
+
+
+def test_batch_analyzer_matches_single():
+    model, variables = _small_model_and_vars()
+    mask, depth, k, scale, _ = make_arc_scene(h=120, w=160, r_px=70.0, band_px=30)
+    frame = np.dstack([mask * 200] * 3).astype(np.uint8)
+    single = pipeline.make_frame_analyzer(model, img_size=64)
+    batched = pipeline.make_batch_analyzer(model, img_size=64)
+    s = single(variables, jnp.asarray(frame), jnp.asarray(depth), jnp.asarray(k), scale)
+    frames = jnp.stack([jnp.asarray(frame)] * 3)
+    depths = jnp.stack([jnp.asarray(depth)] * 3)
+    ks = jnp.stack([jnp.asarray(k, jnp.float32)] * 3)
+    scales = jnp.full((3,), scale, jnp.float32)
+    b = batched(variables, frames, depths, ks, scales)
+    assert b.mask.shape == (3, 120, 160)
+    np.testing.assert_array_equal(np.asarray(b.mask[1]), np.asarray(s.mask))
+    np.testing.assert_allclose(
+        float(b.mask_coverage[0]), float(s.mask_coverage), rtol=1e-5
+    )

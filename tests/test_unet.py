@@ -1,0 +1,100 @@
+"""U-Net architecture tests: parameter-count parity with the reference
+channel ladder, shape behavior, norm variants, and gradient flow."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from robotic_discovery_platform_tpu.models import losses
+from robotic_discovery_platform_tpu.models.unet import UNet, build_unet, init_unet, param_count
+from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+
+def expected_params_bilinear(f=64, in_ch=3, n_cls=1):
+    """Analytic trainable-parameter count for the bilinear ladder
+    (reference: pkg/segmentation_model.py:97-107): DoubleConv(in, out, mid) =
+    9*in*mid + 2*mid + 9*mid*out + 2*out (convs are bias-free; norm has
+    scale+bias)."""
+
+    def dc(cin, cout, mid=None):
+        mid = mid or cout
+        return 9 * cin * mid + 2 * mid + 9 * mid * cout + 2 * cout
+
+    total = dc(in_ch, f)  # inc
+    total += dc(f, 2 * f) + dc(2 * f, 4 * f) + dc(4 * f, 8 * f)  # down1-3
+    total += dc(8 * f, 8 * f)  # down4: 1024//2 = 512
+    total += dc(16 * f, 4 * f, mid=8 * f)  # up1: cat(512,512)=1024 -> 256
+    total += dc(8 * f, 2 * f, mid=4 * f)  # up2
+    total += dc(4 * f, f, mid=2 * f)  # up3
+    total += dc(2 * f, f, mid=f)  # up4: mid = (64+64)//2 = 64
+    total += n_cls * f + n_cls  # 1x1 out conv (with bias)
+    return total
+
+
+def test_param_count_matches_reference_ladder():
+    model = build_unet(ModelConfig())
+    variables = init_unet(model, jax.random.key(0))
+    assert param_count(variables) == expected_params_bilinear()
+
+
+def test_forward_shape_and_dtype():
+    model = build_unet(ModelConfig())
+    variables = init_unet(model, jax.random.key(0))
+    x = jnp.zeros((2, 256, 256, 3))
+    y = model.apply(variables, x, train=False)
+    assert y.shape == (2, 256, 256, 1)
+    assert y.dtype == jnp.float32
+
+
+def test_forward_odd_size():
+    """Resize-to-skip fusion must handle non-power-of-two inputs (the
+    reference pads to match, segmentation_model.py:67-76)."""
+    model = build_unet(ModelConfig())
+    variables = init_unet(model, jax.random.key(0))
+    x = jnp.zeros((1, 250, 198, 3))
+    y = model.apply(variables, x, train=False)
+    assert y.shape == (1, 250, 198, 1)
+
+
+def test_transpose_conv_variant():
+    model = UNet(bilinear=False, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    y = model.apply(variables, jnp.zeros((1, 64, 64, 3)), train=False)
+    assert y.shape == (1, 64, 64, 1)
+
+
+def test_batchnorm_updates_stats():
+    model = build_unet(ModelConfig())
+    variables = init_unet(model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 64, 64, 3))
+    y, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree.leaves(variables["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_groupnorm_variant_has_no_batch_stats():
+    model = build_unet(ModelConfig(norm="group"))
+    variables = init_unet(model, jax.random.key(0))
+    assert "batch_stats" not in variables
+
+
+def test_gradients_flow():
+    model = UNet(base_features=8, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3))
+    labels = (jax.random.uniform(jax.random.key(1), (2, 32, 32, 1)) > 0.5).astype(
+        jnp.float32
+    )
+    variables = model.init(jax.random.key(2), x, train=False)
+
+    def loss_fn(params):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+        )
+        return losses.bce_with_logits(logits, labels)
+
+    grads = jax.grad(loss_fn)(variables["params"])
+    norms = [float(jnp.abs(g).max()) for g in jax.tree.leaves(grads)]
+    assert all(np.isfinite(n) for n in norms)
+    assert max(norms) > 0
