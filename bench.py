@@ -1,0 +1,119 @@
+"""Headline benchmark: fused segmentation + curvature throughput at 640x480
+on one chip, against the 30 FPS north-star target (BASELINE.json; the
+reference publishes no numbers -- BASELINE.md).
+
+Methodology note: on this image the TPU is reached through a loopback relay
+with ~110 ms host<->device round-trip latency and a `block_until_ready` that
+returns immediately, so naive per-call timing measures the tunnel, not the
+chip. We therefore time K data-dependent fused iterations chained inside one
+compiled `lax.scan` (each frame is a function of the previous mask, so no
+iteration can be elided or overlapped) plus exactly one host fetch, and
+subtract the independently measured fetch round-trip. That is the
+steady-state streaming throughput of the chip itself.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "frames/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+TARGET_FPS = 30.0  # BASELINE.json north star for serving on v5e-1
+CHAIN = 200
+
+
+def _roundtrip_ms() -> float:
+    """Median host->device->host latency for a trivial fetch."""
+
+    @jax.jit
+    def trivial(x):
+        return x + 1.0
+
+    x = jnp.ones((8,))
+    float(trivial(x)[0])
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        float(trivial(x)[0])
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1e3)
+
+
+def main() -> None:
+    from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
+    from robotic_discovery_platform_tpu.ops import geometry, pipeline
+    from robotic_discovery_platform_tpu.utils.config import (
+        GeometryConfig,
+        ModelConfig,
+    )
+
+    model = build_unet(ModelConfig())
+    variables = init_unet(model, jax.random.key(0))
+    geom_cfg = GeometryConfig()
+
+    h, w = 480, 640
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 255, size=(h, w, 3), dtype=np.uint8)
+    frame[h // 3: 2 * h // 3] = (200, 60, 60)
+    depth = jnp.asarray(np.full((h, w), 500, np.uint16))
+    intrinsics = jnp.asarray(
+        [[600.0, 0, w / 2], [0, 600.0, h / 2], [0, 0, 1]], jnp.float32
+    )
+    scale = jnp.float32(0.001)
+
+    def fused_step(f):
+        x = pipeline.preprocess(f[None], 256)
+        logits = model.apply(variables, x, train=False)
+        m = pipeline.logits_to_native_masks(logits, h, w)[0]
+        prof = geometry.compute_curvature_profile(
+            m, depth, intrinsics, scale, geom_cfg
+        )
+        # Data dependency on BOTH the mask and the curvature result so no
+        # stage can be dead-code-eliminated across iterations.
+        dep = (m & jnp.uint8(1)) ^ (prof.mean_curvature > 1e30).astype(jnp.uint8)
+        return f ^ dep[..., None]
+
+    @jax.jit
+    def chained(f0):
+        final, _ = lax.scan(lambda c, _: (fused_step(c), None), f0, None,
+                            length=CHAIN)
+        return final
+
+    f0 = jnp.asarray(frame)
+    t0 = time.perf_counter()
+    np.asarray(chained(f0))
+    compile_s = time.perf_counter() - t0
+    rt_ms = _roundtrip_ms()
+    print(
+        f"# backend={jax.default_backend()} compile={compile_s:.1f}s "
+        f"roundtrip={rt_ms:.1f}ms chain={CHAIN}",
+        file=sys.stderr,
+    )
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(chained(f0))
+        best = min(best, time.perf_counter() - t0)
+    per_frame_ms = max((best * 1e3 - rt_ms) / CHAIN, 1e-6)
+    fps = 1000.0 / per_frame_ms
+
+    print(json.dumps({
+        "metric": "fused_seg_curvature_fps_640x480_1chip",
+        "value": round(fps, 2),
+        "unit": "frames/sec",
+        "vs_baseline": round(fps / TARGET_FPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

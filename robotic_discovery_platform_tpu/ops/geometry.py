@@ -122,9 +122,9 @@ def _edge_points(pts, w_sel, cfg: GeometryConfig):
         vals, idxs = jax.lax.top_k(yk, cfg.max_per_bin)
         rank = jnp.arange(cfg.max_per_bin)
         # k_b is implicitly capped at the static max_per_bin budget; with the
-        # default 5% rule that only binds when one bin holds >5120 points
-        # (degenerate x-range) -- such frames also set `truncated` upstream
-        # or fail the edge-count minimum.
+        # default 5% rule that only binds when one bin holds more than
+        # max_per_bin / top_k_percent points (degenerate x-range) -- such
+        # frames also set `truncated` upstream or fail the edge-count minimum.
         keep = (rank < k_b) & (vals > -big)
         return pts[idxs], keep.astype(jnp.float32)
 

@@ -1,0 +1,25 @@
+from robotic_discovery_platform_tpu.parallel.mesh import (
+    AXES,
+    batch_sharding,
+    initialize_distributed,
+    make_mesh,
+    replicated,
+    shard_pytree,
+    tp_param_specs,
+)
+from robotic_discovery_platform_tpu.parallel.dp import (
+    parallelize_training,
+    shard_map_train_step,
+)
+
+__all__ = [
+    "AXES",
+    "batch_sharding",
+    "initialize_distributed",
+    "make_mesh",
+    "parallelize_training",
+    "replicated",
+    "shard_map_train_step",
+    "shard_pytree",
+    "tp_param_specs",
+]

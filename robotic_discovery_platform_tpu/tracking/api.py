@@ -1,0 +1,238 @@
+"""MLflow-shaped tracking API over the file store.
+
+Drop-in for the subset of the MLflow surface the reference exercises:
+``set_tracking_uri`` / ``set_experiment`` / ``start_run`` / ``log_params`` /
+``log_metric`` (reference: scripts/train_segmenter.py:112-129,183-191),
+model logging + registration (:195-207), ``MlflowClient.get_latest_versions``
+and ``set_registered_model_alias`` (reference: workflows/
+retraining_pipeline.py:50-74), and ``load_model("models:/Name/latest" |
+"models:/Name@alias" | "models:/Name/3")`` (reference: services/
+vision_analysis/server.py:81-82 plus README.md:147's documented staging-alias
+intent).
+
+Model artifacts are Flax variable trees serialized with
+``flax.serialization`` plus a JSON model config, so a registry entry is
+self-describing: ``load_model`` rebuilds the Flax module and returns
+``(model, variables)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import re
+import threading
+from pathlib import Path
+from types import SimpleNamespace
+
+from robotic_discovery_platform_tpu.tracking.store import FileStore
+from robotic_discovery_platform_tpu.utils.config import ModelConfig, from_dict
+
+_DEFAULT_URI = "file:ml/mlruns"
+
+# Process-global like real MLflow (the gRPC server's worker threads must see
+# the URI the main thread configured); guarded for concurrent mutation.
+_state = SimpleNamespace(
+    uri=_DEFAULT_URI, store=None, experiment_id="0", active_run=None
+)
+_state_lock = threading.Lock()
+
+
+def _globals():
+    return _state
+
+
+def set_tracking_uri(uri: str) -> None:
+    with _state_lock:
+        _state.uri = uri
+        _state.store = None
+
+
+def get_tracking_uri() -> str:
+    return _globals().uri
+
+
+def _store() -> FileStore:
+    with _state_lock:
+        if _state.store is None:
+            _state.store = FileStore(_state.uri)
+        return _state.store
+
+
+def set_experiment(name: str) -> str:
+    g = _globals()
+    g.experiment_id = _store().get_or_create_experiment(name)
+    return g.experiment_id
+
+
+class ActiveRun:
+    """Mimics ``mlflow.ActiveRun``: has ``.info.run_id``."""
+
+    class _Info:
+        def __init__(self, run_id: str):
+            self.run_id = run_id
+
+    def __init__(self, run_id: str):
+        self.info = self._Info(run_id)
+
+
+@contextlib.contextmanager
+def start_run(run_name: str | None = None):
+    g = _globals()
+    run_id = _store().create_run(g.experiment_id, run_name)
+    g.active_run = ActiveRun(run_id)
+    try:
+        yield g.active_run
+        _store().end_run(run_id, "FINISHED")
+    except Exception:
+        _store().end_run(run_id, "FAILED")
+        raise
+    finally:
+        g.active_run = None
+
+
+def active_run() -> ActiveRun | None:
+    return _globals().active_run
+
+
+def _require_run() -> str:
+    run = active_run()
+    if run is None:
+        raise RuntimeError("no active run; wrap calls in tracking.start_run()")
+    return run.info.run_id
+
+
+def log_params(params: dict) -> None:
+    _store().log_params(_require_run(), params)
+
+
+def log_param(key: str, value) -> None:
+    log_params({key: value})
+
+
+def log_metric(key: str, value: float, step: int | None = None) -> None:
+    _store().log_metric(_require_run(), key, value, step)
+
+
+def log_metrics(metrics: dict, step: int | None = None) -> None:
+    for k, v in metrics.items():
+        log_metric(k, v, step)
+
+
+def get_metric_history(run_id: str, key: str) -> list[dict]:
+    return _store().get_metric_history(run_id, key)
+
+
+# ---------------------------------------------------------------------------
+# Model logging / registry
+# ---------------------------------------------------------------------------
+
+_MODEL_CONFIG_FILE = "model_config.json"
+_MODEL_WEIGHTS_FILE = "variables.msgpack"
+
+
+def save_model(variables, model_cfg: ModelConfig, path: Path) -> None:
+    """Write a self-describing model artifact directory."""
+    from flax import serialization
+
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    (path / _MODEL_CONFIG_FILE).write_text(
+        json.dumps(dataclasses.asdict(model_cfg), indent=2)
+    )
+    (path / _MODEL_WEIGHTS_FILE).write_bytes(serialization.to_bytes(variables))
+
+
+def load_model_dir(path: Path):
+    """Load (model, variables) from an artifact directory."""
+    from flax import serialization
+
+    from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
+
+    path = Path(path)
+    cfg = from_dict(ModelConfig, json.loads((path / _MODEL_CONFIG_FILE).read_text()))
+    model = build_unet(cfg)
+    import jax
+
+    template = init_unet(model, jax.random.key(0))
+    variables = serialization.from_bytes(
+        template, (path / _MODEL_WEIGHTS_FILE).read_bytes()
+    )
+    return model, variables
+
+
+def log_model(variables, model_cfg: ModelConfig, artifact_path: str = "model",
+              registered_model_name: str | None = None) -> int | None:
+    """Save the model under the active run's artifacts and optionally register
+    a new version (the reference's ``mlflow.pytorch.log_model(...,
+    registered_model_name=...)`` flow, train_segmenter.py:200-206).
+
+    Returns the new registry version when registered.
+    """
+    run_id = _require_run()
+    dest = _store().artifact_dir(run_id) / artifact_path
+    save_model(variables, model_cfg, dest)
+    if registered_model_name is None:
+        return None
+    return _store().create_model_version(registered_model_name, run_id, dest)
+
+
+_MODEL_URI = re.compile(
+    r"^models:/(?P<name>[^/@]+)(?:/(?P<version>latest|\d+)|@(?P<alias>[\w-]+))?$"
+)
+
+
+def resolve_model_uri(uri: str) -> Path:
+    """models:/Name/latest | models:/Name/3 | models:/Name@staging -> path."""
+    m = _MODEL_URI.match(uri)
+    if not m:
+        raise ValueError(f"unsupported model uri: {uri!r}")
+    name = m.group("name")
+    store = _store()
+    if m.group("alias"):
+        version = store.get_alias(name, m.group("alias"))
+        if version is None:
+            raise KeyError(f"model {name!r} has no alias {m.group('alias')!r}")
+    elif m.group("version") and m.group("version") != "latest":
+        version = int(m.group("version"))
+    else:
+        version = store.latest_version(name)["version"]
+    return store.version_path(name, version)
+
+
+def load_model(uri: str):
+    """Load (model, variables) from a ``models:/`` uri or a plain path."""
+    if uri.startswith("models:/"):
+        return load_model_dir(resolve_model_uri(uri))
+    return load_model_dir(Path(uri))
+
+
+class ModelVersionInfo:
+    """Mimics mlflow's ModelVersion for the fields the reference touches
+    (retraining_pipeline.py:60-66: ``.version``)."""
+
+    def __init__(self, name: str, version: int, run_id: str | None):
+        self.name = name
+        self.version = version
+        self.run_id = run_id
+
+
+class Client:
+    """Registry client with the reference's MlflowClient call shapes."""
+
+    def get_latest_versions(self, name: str, stages=None) -> list[ModelVersionInfo]:
+        v = _store().latest_version(name)
+        return [ModelVersionInfo(name, v["version"], v.get("run_id"))]
+
+    def set_registered_model_alias(self, name: str, alias: str, version) -> None:
+        _store().set_alias(name, alias, int(version))
+
+    def get_model_version_by_alias(self, name: str, alias: str) -> ModelVersionInfo:
+        version = _store().get_alias(name, alias)
+        if version is None:
+            raise KeyError(f"model {name!r} has no alias {alias!r}")
+        return ModelVersionInfo(name, version, None)
+
+    def list_versions(self, name: str) -> list[dict]:
+        return _store().list_model_versions(name)

@@ -1,0 +1,42 @@
+"""Orbax checkpoint/resume.
+
+The reference has no mid-run durability at all: an interrupted training run
+loses everything except the last best-model file (reference:
+scripts/train_segmenter.py:148-189; SURVEY.md section 5.4). Here every epoch
+checkpoints the full train state (params, optimizer state, batch stats,
+epoch counter, best-val bookkeeping) through orbax -- which is also
+sharding-aware, so the same path serves the data-parallel trainer.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str | Path, keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True, enable_async_checkpointing=False
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, template: Any, step: int | None = None) -> Any:
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+
+    def close(self) -> None:
+        self._mgr.close()

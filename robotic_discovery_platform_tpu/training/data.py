@@ -1,0 +1,137 @@
+"""Dataset loading and the host input pipeline.
+
+Reference behavior preserved (scripts/train_segmenter.py:66-100): image/mask
+pairing by identical filename, BGR->RGB, INTER_AREA resize for images and
+INTER_NEAREST for masks to ``img_size``, /255 normalization, deterministic
+80/20 split. TPU-first departures:
+
+- **NHWC numpy batches** instead of per-sample CHW tensors; the jitted train
+  step consumes whole batches.
+- **Background prefetch**: the reference loads synchronously inside the train
+  loop with ``num_workers=0`` (train_segmenter.py:138-139), starving the
+  device; here a daemon thread decodes/augments the next batches while the
+  TPU runs the current step (SURVEY.md section 3.3 "async host input
+  pipeline").
+- **Sharding-aware batching**: ``Batches`` can pad/trim to a global batch
+  divisible by the data-parallel world size.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from pathlib import Path
+
+import numpy as np
+
+
+class PairedSegmentationData:
+    """File-pair dataset (reference: SegmentationDataset,
+    train_segmenter.py:66-100)."""
+
+    def __init__(self, dataset_dir: str | Path, img_size: int = 256):
+        self.root = Path(dataset_dir)
+        self.img_size = img_size
+        img_dir = self.root / "images"
+        mask_dir = self.root / "masks"
+        if not img_dir.is_dir() or not mask_dir.is_dir():
+            raise FileNotFoundError(
+                f"dataset at {self.root} needs images/ and masks/ subdirs "
+                "(generate one with training.synthetic.generate_dataset)"
+            )
+        mask_names = {p.name for p in mask_dir.iterdir()}
+        self.names = sorted(p.name for p in img_dir.iterdir() if p.name in mask_names)
+        if not self.names:
+            raise FileNotFoundError(f"no paired image/mask files in {self.root}")
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def load(self, name: str):
+        import cv2
+
+        img = cv2.imread(str(self.root / "images" / name), cv2.IMREAD_COLOR)
+        mask = cv2.imread(str(self.root / "masks" / name), cv2.IMREAD_GRAYSCALE)
+        if img is None or mask is None:
+            raise IOError(f"failed to read pair {name!r}")
+        s = self.img_size
+        img = cv2.resize(img, (s, s), interpolation=cv2.INTER_AREA)[..., ::-1]
+        mask = cv2.resize(mask, (s, s), interpolation=cv2.INTER_NEAREST)
+        x = img.astype(np.float32) / 255.0
+        y = (mask.astype(np.float32) / 255.0)[..., None]
+        return x, y
+
+    def as_arrays(self, names=None):
+        names = self.names if names is None else names
+        xs = np.zeros((len(names), self.img_size, self.img_size, 3), np.float32)
+        ys = np.zeros((len(names), self.img_size, self.img_size, 1), np.float32)
+        for i, n in enumerate(names):
+            xs[i], ys[i] = self.load(n)
+        return xs, ys
+
+
+def train_val_split(n: int, val_fraction: float, seed: int = 0):
+    """Deterministic shuffled split (reference uses torch random_split 80/20,
+    train_segmenter.py:134-136)."""
+    order = np.random.default_rng(seed).permutation(n)
+    n_val = max(1, int(round(n * val_fraction))) if n > 1 else 0
+    return order[n_val:], order[:n_val]
+
+
+class Batches:
+    """Epoch iterator over in-memory arrays with shuffling, optional
+    divisibility padding, and background prefetch."""
+
+    def __init__(self, xs, ys, batch_size: int, shuffle: bool = True,
+                 seed: int = 0, divisor: int = 1, prefetch: int = 2):
+        if len(xs) == 0:
+            raise ValueError("empty dataset")
+        if divisor > 1 and batch_size % divisor:
+            raise ValueError(
+                f"batch_size {batch_size} must be divisible by the "
+                f"data-parallel world size {divisor}"
+            )
+        self.xs, self.ys = xs, ys
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = np.random.default_rng(seed)
+        self.divisor = divisor
+        self.prefetch = prefetch
+
+    def _epoch_order(self):
+        order = np.arange(len(self.xs))
+        if self.shuffle:
+            self.rng.shuffle(order)
+        b = self.batch_size
+        # pad the tail so every batch is full and divisible (wrap-around),
+        # keeping jit shapes static
+        n_batches = max(1, int(np.ceil(len(order) / b)))
+        need = n_batches * b - len(order)
+        if need:
+            order = np.concatenate([order, order[:need]])
+        return order.reshape(n_batches, b)
+
+    def __iter__(self):
+        batches = self._epoch_order()
+        if self.prefetch <= 0:
+            for idx in batches:
+                yield self.xs[idx], self.ys[idx]
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = object()
+
+        def producer():
+            for idx in batches:
+                q.put((self.xs[idx], self.ys[idx]))
+            q.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            yield item
+
+    def __len__(self):
+        return max(1, int(np.ceil(len(self.xs) / self.batch_size)))

@@ -1,0 +1,112 @@
+"""Synthetic actuator-scene generator.
+
+The reference's data story has a documented hole: the collector saves raw
+color/depth pairs (reference: scripts/02_collect_segmentation_data.py:84-94),
+the trainer expects labeled pairs under ``ml/datasets/processed/{images,masks}``
+(reference: scripts/train_segmenter.py:54-56), and the raw->labeled step in
+between does not exist in the repo (README.md:48 claims auto-labeling;
+SURVEY.md section 2.1). This module closes the loop with a parametric scene
+generator: curved actuator bands (the same geometry family the curvature
+engine analyzes) rendered over textured backgrounds, with exact masks --
+usable both as a standalone dataset and as a labeling-free smoke path for
+the full train->register->serve cycle.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+def render_scene(rng: np.random.Generator, h: int = 480, w: int = 640):
+    """One (image_u8 [h,w,3], mask_u8 [h,w], depth_u16 [h,w]) sample.
+
+    The actuator is a band of pixels between two vertical offsets of a random
+    circular arc -- matching the soft-actuator silhouettes the reference
+    pipeline segments, with randomized radius (hence curvature), pose,
+    thickness, color, lighting, and background clutter.
+    """
+    uu, vv = np.meshgrid(np.arange(w, dtype=np.float32),
+                         np.arange(h, dtype=np.float32))
+
+    # --- background: low-frequency color gradient + speckle
+    base = rng.uniform(40, 160, size=3).astype(np.float32)
+    gx = rng.uniform(-40, 40, size=3).astype(np.float32)
+    gy = rng.uniform(-40, 40, size=3).astype(np.float32)
+    img = (
+        base[None, None, :]
+        + gx[None, None, :] * (uu / w)[..., None]
+        + gy[None, None, :] * (vv / h)[..., None]
+    )
+    img += rng.normal(0, 8, size=(h, w, 3)).astype(np.float32)
+
+    # distractor blobs
+    for _ in range(rng.integers(0, 4)):
+        bx, by = rng.uniform(0, w), rng.uniform(0, h)
+        br = rng.uniform(10, 60)
+        blob = ((uu - bx) ** 2 + (vv - by) ** 2) < br ** 2
+        img[blob] = rng.uniform(0, 255, size=3)
+
+    # --- actuator band along a random arc (parameters relative to frame
+    # size; the arc apex is anchored inside the image so masks are nonempty
+    # at any resolution)
+    r_px = rng.uniform(0.5, 2.5) * w
+    cx = rng.uniform(0.3 * w, 0.7 * w)
+    v_apex = rng.uniform(0.35, 0.85) * h  # lowest arc point, at u == cx
+    cy_top = v_apex - r_px
+    thickness = rng.uniform(0.12, 0.3) * h
+    half_span = rng.uniform(0.25, 0.45) * w
+    inside = np.abs(uu - cx) <= min(half_span, 0.95 * r_px)
+    v_edge = cy_top + np.sqrt(np.maximum(r_px ** 2 - (uu - cx) ** 2, 0.0))
+    mask = inside & (vv <= v_edge) & (vv >= v_edge - thickness)
+
+    color = rng.uniform(0, 255, size=3).astype(np.float32)
+    shade = 1.0 - 0.4 * np.clip((v_edge - vv) / max(thickness, 1), 0, 1)
+    img[mask] = color[None, :] * shade[mask][:, None]
+    img = np.clip(img, 0, 255).astype(np.uint8)
+
+    # --- depth: flat backdrop, actuator slightly closer, mm units (z16)
+    z_back = rng.uniform(700, 1200)
+    z_act = z_back - rng.uniform(80, 250)
+    depth = np.full((h, w), z_back, np.float32)
+    depth[mask] = z_act
+    depth += rng.normal(0, 2, size=(h, w))
+    depth = np.clip(depth, 0, 65535).astype(np.uint16)
+
+    return img, mask.astype(np.uint8) * 255, depth
+
+
+def generate_arrays(n: int, h: int = 256, w: int = 256, seed: int = 0):
+    """In-memory dataset: (images [n,h,w,3] u8, masks [n,h,w,1] u8/255)."""
+    rng = np.random.default_rng(seed)
+    imgs = np.zeros((n, h, w, 3), np.uint8)
+    masks = np.zeros((n, h, w, 1), np.uint8)
+    for i in range(n):
+        img, mask, _ = render_scene(rng, h, w)
+        imgs[i] = img
+        masks[i, ..., 0] = mask
+    return imgs, masks
+
+
+def generate_dataset(out_dir: str | Path, n: int, h: int = 480, w: int = 640,
+                     seed: int = 0, with_depth: bool = False) -> Path:
+    """Write ``{images,masks}[,depth]`` file pairs with identical stems --
+    the pairing convention the trainer requires (reference:
+    scripts/train_segmenter.py:54-56,73)."""
+    import cv2
+
+    out = Path(out_dir)
+    (out / "images").mkdir(parents=True, exist_ok=True)
+    (out / "masks").mkdir(parents=True, exist_ok=True)
+    if with_depth:
+        (out / "depth").mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        img, mask, depth = render_scene(rng, h, w)
+        stem = f"sample_{i:05d}.png"
+        cv2.imwrite(str(out / "images" / stem), img[..., ::-1])  # RGB -> BGR
+        cv2.imwrite(str(out / "masks" / stem), mask)
+        if with_depth:
+            np.save(out / "depth" / f"sample_{i:05d}.npy", depth)
+    return out

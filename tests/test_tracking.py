@@ -1,0 +1,114 @@
+"""Tracking store + MLflow-shaped API tests, including the full reference
+lifecycle: train-run logging -> model registration -> staging alias ->
+models:/ uri resolution."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.models.unet import UNet, init_unet
+from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+
+@pytest.fixture()
+def store_uri(tmp_path):
+    uri = f"file:{tmp_path}/mlruns"
+    tracking.set_tracking_uri(uri)
+    yield uri
+
+
+def test_run_params_metrics(store_uri):
+    tracking.set_experiment("Actuator Segmentation")
+    with tracking.start_run() as run:
+        tracking.log_params({"learning_rate": 1e-4, "batch_size": 4})
+        for epoch in range(3):
+            tracking.log_metric("train_loss", 1.0 / (epoch + 1), step=epoch)
+            tracking.log_metric("val_loss", 2.0 / (epoch + 1), step=epoch)
+        run_id = run.info.run_id
+    hist = tracking.get_metric_history(run_id, "train_loss")
+    assert [h["step"] for h in hist] == [0, 1, 2]
+    assert hist[-1]["value"] == pytest.approx(1 / 3)
+    store = tracking.FileStore(store_uri)
+    assert store.get_params(run_id)["batch_size"] == "4"
+    assert store.get_run(run_id)["status"] == "FINISHED"
+
+
+def test_failed_run_marked(store_uri):
+    tracking.set_experiment("x")
+    with pytest.raises(RuntimeError):
+        with tracking.start_run() as run:
+            run_id = run.info.run_id
+            raise RuntimeError("boom")
+    assert tracking.FileStore(store_uri).get_run(run_id)["status"] == "FAILED"
+
+
+def test_metric_outside_run_raises(store_uri):
+    with pytest.raises(RuntimeError):
+        tracking.log_metric("x", 1.0)
+
+
+def _tiny_model():
+    cfg = ModelConfig(base_features=8, compute_dtype="float32")
+    from robotic_discovery_platform_tpu.models.unet import build_unet
+
+    model = build_unet(cfg)
+    variables = init_unet(model, jax.random.key(0), img_size=32)
+    return cfg, model, variables
+
+
+def test_model_registry_lifecycle(store_uri):
+    """The full reference loop: train registers a version
+    (train_segmenter.py:200-206), the pipeline promotes it to staging
+    (retraining_pipeline.py:60-74), the server resolves the alias with a
+    latest fallback (server.py:81 + README.md:147)."""
+    cfg, model, variables = _tiny_model()
+    tracking.set_experiment("Actuator Segmentation")
+    with tracking.start_run():
+        v1 = tracking.log_model(variables, cfg, registered_model_name="Actuator-Segmenter")
+    assert v1 == 1
+    with tracking.start_run():
+        v2 = tracking.log_model(variables, cfg, registered_model_name="Actuator-Segmenter")
+    assert v2 == 2
+
+    client = tracking.Client()
+    latest = client.get_latest_versions("Actuator-Segmenter", stages=["None"])
+    assert latest[0].version == 2
+    client.set_registered_model_alias("Actuator-Segmenter", "staging", latest[0].version)
+    assert client.get_model_version_by_alias("Actuator-Segmenter", "staging").version == 2
+
+    for uri in ("models:/Actuator-Segmenter/latest",
+                "models:/Actuator-Segmenter@staging",
+                "models:/Actuator-Segmenter/1"):
+        m, loaded = tracking.load_model(uri)
+        assert isinstance(m, UNet)
+        x = jnp.zeros((1, 32, 32, 3))
+        y = m.apply(loaded, x, train=False)
+        assert y.shape == (1, 32, 32, 1)
+
+
+def test_loaded_weights_roundtrip(store_uri):
+    cfg, model, variables = _tiny_model()
+    tracking.set_experiment("e")
+    with tracking.start_run():
+        tracking.log_model(variables, cfg, registered_model_name="M")
+    _, loaded = tracking.load_model("models:/M/latest")
+    for a, b in zip(jax.tree.leaves(variables), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_alias_to_unknown_version_rejected(store_uri):
+    cfg, model, variables = _tiny_model()
+    tracking.set_experiment("e")
+    with tracking.start_run():
+        tracking.log_model(variables, cfg, registered_model_name="M")
+    with pytest.raises(KeyError):
+        tracking.Client().set_registered_model_alias("M", "staging", 99)
+
+
+def test_bad_model_uri(store_uri):
+    with pytest.raises(ValueError):
+        tracking.resolve_model_uri("models://bad//uri")
