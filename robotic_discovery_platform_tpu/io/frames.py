@@ -1,0 +1,224 @@
+"""Frame sources: the hardware seam the reference lacks.
+
+The reference's only capture path is a live RealSense camera wrapped in a
+thread (reference: pkg/camera.py) -- nothing else in the system can run
+without hardware (SURVEY.md section 4). Here every consumer (client,
+collector, calibrator, tests, benches) takes a :class:`FrameSource`:
+
+- :class:`SyntheticSource` -- renders parametric actuator scenes (no
+  hardware, deterministic, used by CI and the service integration tests);
+- :class:`ReplaySource` -- replays color/depth pairs recorded by the
+  collector tool;
+- :class:`RealSenseSource` -- the live D4XX camera, import-gated so the
+  package works on TPU hosts without librealsense. Mirrors the reference's
+  threading/align/depth-scale behavior and fixes its half-copied tuple race
+  (reference: pkg/camera.py:117-134 copies only the color array; SURVEY.md
+  section 5.2).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Iterator, Protocol
+
+import numpy as np
+
+
+class FrameSource(Protocol):
+    """A source of aligned (color_bgr_u8 [H,W,3], depth_u16 [H,W]) pairs."""
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+    def get_frames(self) -> tuple[np.ndarray, np.ndarray] | tuple[None, None]: ...
+
+    @property
+    def depth_scale(self) -> float: ...
+
+
+class SyntheticSource:
+    """Deterministic stream of rendered actuator scenes."""
+
+    def __init__(self, width: int = 640, height: int = 480, seed: int = 0,
+                 n_frames: int | None = None):
+        self.width, self.height = width, height
+        self.seed = seed
+        self.n_frames = n_frames
+        self._count = 0
+        self._rng = np.random.default_rng(seed)
+
+    def start(self) -> None:
+        self._count = 0
+        self._rng = np.random.default_rng(self.seed)
+
+    def stop(self) -> None:
+        pass
+
+    @property
+    def depth_scale(self) -> float:
+        return 0.001
+
+    def get_frames(self):
+        from robotic_discovery_platform_tpu.training.synthetic import render_scene
+
+        if self.n_frames is not None and self._count >= self.n_frames:
+            return None, None
+        self._count += 1
+        img_rgb, _, depth = render_scene(self._rng, self.height, self.width)
+        return img_rgb[..., ::-1].copy(), depth  # BGR like a real camera
+
+    def intrinsics(self) -> np.ndarray:
+        f = 0.94 * self.width  # RealSense-like FOV
+        return np.array(
+            [[f, 0, self.width / 2], [0, f, self.height / 2], [0, 0, 1]],
+            np.float64,
+        )
+
+
+class ReplaySource:
+    """Replays a collection directory (color/*.png + depth/*.npy pairs, the
+    collector tool's layout -- reference: scripts/02_collect_segmentation_data.py
+    :50-52,84-94)."""
+
+    def __init__(self, root: str | Path, loop: bool = True,
+                 depth_scale: float = 0.001):
+        self.root = Path(root)
+        self.loop = loop
+        self._depth_scale = depth_scale
+        color_dir = self.root / "color"
+        depth_dir = self.root / "depth"
+        if not color_dir.is_dir() or not depth_dir.is_dir():
+            raise FileNotFoundError(f"{self.root} needs color/ and depth/ subdirs")
+        self.stems = sorted(
+            p.stem for p in color_dir.glob("*.png")
+            if (depth_dir / f"{p.stem}.npy").exists()
+        )
+        if not self.stems:
+            raise FileNotFoundError(f"no replayable pairs under {self.root}")
+        self._idx = 0
+
+    def start(self) -> None:
+        self._idx = 0
+
+    def stop(self) -> None:
+        pass
+
+    @property
+    def depth_scale(self) -> float:
+        return self._depth_scale
+
+    def get_frames(self):
+        import cv2
+
+        if self._idx >= len(self.stems):
+            if not self.loop:
+                return None, None
+            self._idx = 0
+        stem = self.stems[self._idx]
+        self._idx += 1
+        color = cv2.imread(str(self.root / "color" / f"{stem}.png"), cv2.IMREAD_COLOR)
+        depth = np.load(self.root / "depth" / f"{stem}.npy")
+        return color, depth.astype(np.uint16)
+
+
+class RealSenseSource:
+    """Live Intel RealSense D4XX capture (reference: pkg/camera.py).
+
+    Import of pyrealsense2 happens at construction so the module stays
+    importable on TPU hosts. A daemon thread blocks on the camera, aligns
+    depth to color, and publishes the latest *fully copied* pair under a
+    lock (the reference shares the live depth-frame handle across threads).
+    """
+
+    def __init__(self, width: int = 640, height: int = 480, fps: int = 30):
+        import pyrealsense2 as rs  # hardware-gated
+
+        self._rs = rs
+        self.width, self.height, self.fps = width, height, fps
+        self._pipeline = rs.pipeline()
+        self._config = rs.config()
+        self._config.enable_stream(rs.stream.depth, width, height, rs.format.z16, fps)
+        self._config.enable_stream(rs.stream.color, width, height, rs.format.bgr8, fps)
+        self._align = None
+        self._depth_scale = 0.001
+        self._latest: tuple[np.ndarray, np.ndarray] | None = None
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        rs = self._rs
+        profile = self._pipeline.start(self._config)
+        self._align = rs.align(rs.stream.color)
+        self._depth_scale = float(
+            profile.get_device().first_depth_sensor().get_depth_scale()
+        )
+        self._stopped.clear()
+        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+        self._thread.start()
+
+    def _read_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                frames = self._pipeline.wait_for_frames()
+                aligned = self._align.process(frames)
+                depth = aligned.get_depth_frame()
+                color = aligned.get_color_frame()
+                if not depth or not color:
+                    continue
+                pair = (
+                    np.asanyarray(color.get_data()).copy(),
+                    np.asanyarray(depth.get_data()).copy(),
+                )
+                with self._lock:
+                    self._latest = pair
+            except RuntimeError:
+                # camera disconnect: back off and retry (reference
+                # camera.py:112-115)
+                time.sleep(0.1)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        self._pipeline.stop()
+
+    @property
+    def depth_scale(self) -> float:
+        return self._depth_scale
+
+    def get_frames(self):
+        with self._lock:
+            if self._latest is None:
+                return None, None
+            return self._latest  # already copied in the reader thread
+
+
+def iter_frames(source: FrameSource, max_frames: int | None = None,
+                poll_s: float = 0.005) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Convenience iterator over a started source; stops on (None, None) or
+    after ``max_frames``."""
+    n = 0
+    while max_frames is None or n < max_frames:
+        color, depth = source.get_frames()
+        if color is None:
+            if isinstance(source, RealSenseSource):
+                time.sleep(poll_s)
+                continue
+            return
+        yield color, depth
+        n += 1
+
+
+def load_calibration(path: str | Path) -> tuple[np.ndarray, np.ndarray, float | None]:
+    """Read (intrinsics 3x3, distortion, depth_scale|None) from the
+    calibration npz (keys mtx/dist/depth_scale -- reference:
+    pkg/camera.py:136-155, services/vision_analysis/server.py:92-94)."""
+    data = np.load(path)
+    if "mtx" not in data or "dist" not in data:
+        raise KeyError(f"{path} missing 'mtx'/'dist' calibration keys")
+    scale = float(data["depth_scale"]) if "depth_scale" in data else None
+    return data["mtx"], data["dist"], scale

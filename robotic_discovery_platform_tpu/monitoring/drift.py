@@ -1,0 +1,116 @@
+"""Drift detection over the serving metrics CSV.
+
+Same data contract and decision rule as the reference detector (reference:
+scripts/monitoring/drift_detector.py): consume
+``logs/vision_service_metrics.csv``, require >= ``min_rows`` rows, treat the
+first ``baseline_fraction`` of the log as the baseline, flag drift when the
+recent mean ``mask_coverage_percent`` deviates from the baseline mean by more
+than ``threshold`` (relative), recommend retraining, and always render a
+report figure (raw series + rolling mean + shaded baseline/recent spans).
+
+Differences from the reference: the result is a structured
+:class:`DriftReport` (the reference only prints), and the retraining
+recommendation can directly drive ``workflows.retraining`` instead of asking
+a human to run it (closing the loop the reference leaves manual --
+SURVEY.md section 3.5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from robotic_discovery_platform_tpu.utils.config import DriftConfig
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class DriftReport:
+    analyzed: bool  # False when the log is too short
+    drifted: bool
+    baseline_mean: float
+    recent_mean: float
+    relative_change: float
+    n_rows: int
+    report_path: str | None
+    reason: str
+
+
+def analyze_drift(cfg: DriftConfig = DriftConfig(),
+                  render: bool = True) -> DriftReport:
+    import pandas as pd
+
+    path = Path(cfg.metrics_csv)
+    if not path.exists():
+        return DriftReport(False, False, 0.0, 0.0, 0.0, 0, None,
+                           f"no metrics log at {path}")
+    df = pd.read_csv(path)
+    n = len(df)
+    if n < cfg.min_rows:
+        return DriftReport(
+            False, False, 0.0, 0.0, 0.0, n, None,
+            f"only {n} rows (< {cfg.min_rows}); not enough data",
+        )
+
+    split = int(n * cfg.baseline_fraction)
+    col = df["mask_coverage_percent"].astype(float)
+    baseline = col.iloc[:split]
+    recent = col.iloc[split:]
+    b_mean = float(baseline.mean())
+    r_mean = float(recent.mean())
+    change = abs(r_mean - b_mean) / max(abs(b_mean), 1e-9)
+    drifted = change > cfg.threshold
+
+    report_path = None
+    if render:
+        report_path = _render_report(cfg, col, split, b_mean, r_mean)
+
+    reason = (
+        f"mask coverage mean moved {change:.1%} "
+        f"({b_mean:.2f} -> {r_mean:.2f}); threshold {cfg.threshold:.0%}"
+    )
+    if drifted:
+        log.warning("DRIFT DETECTED: %s -- recommend running the retraining "
+                    "pipeline (workflows.retraining)", reason)
+    else:
+        log.info("no drift: %s", reason)
+    return DriftReport(True, drifted, b_mean, r_mean, change, n, report_path,
+                       reason)
+
+
+def _render_report(cfg: DriftConfig, series, split: int,
+                   b_mean: float, r_mean: float) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    out = Path(cfg.report_path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    fig, ax = plt.subplots(figsize=(10, 5))
+    x = np.arange(len(series))
+    ax.plot(x, series, alpha=0.35, lw=0.8, label="mask coverage %")
+    rolling = series.rolling(cfg.rolling_window, min_periods=1).mean()
+    ax.plot(x, rolling, lw=2.0, label=f"rolling mean ({cfg.rolling_window})")
+    ax.axvspan(0, split, alpha=0.08, color="tab:green",
+               label=f"baseline (mean {b_mean:.2f})")
+    ax.axvspan(split, len(series), alpha=0.08, color="tab:orange",
+               label=f"recent (mean {r_mean:.2f})")
+    ax.set_xlabel("frame")
+    ax.set_ylabel("mask coverage %")
+    ax.set_title("Vision service drift report")
+    ax.legend(loc="best")
+    fig.tight_layout()
+    fig.savefig(out, dpi=cfg.report_dpi)
+    plt.close(fig)
+    return str(out)
+
+
+if __name__ == "__main__":
+    from robotic_discovery_platform_tpu.utils.config import parse_config
+
+    analyze_drift(parse_config().drift)

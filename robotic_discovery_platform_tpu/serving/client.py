@@ -1,0 +1,179 @@
+"""Streaming client: FrameSource -> gRPC -> (optional) live overlay UI.
+
+Rebuild of the reference client (reference: services/vision_analysis/
+client.py): JPEG-encodes color / PNG-encodes depth (lossy vs lossless, the
+reference's deliberate asymmetry, client.py:63-67), streams them over the
+bidirectional rpc, smooths curvature over a 10-frame window, and -- when a
+display is requested -- alpha-blends the returned mask and reprojects the 3D
+spline with the calibrated intrinsics. Headless operation is first-class
+(the reference hard-requires a GUI): results are returned as a list so
+tests, benches, and batch jobs can consume the same path.
+"""
+
+from __future__ import annotations
+
+import queue
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import grpc
+
+from robotic_discovery_platform_tpu.io.frames import (
+    FrameSource,
+    SyntheticSource,
+    iter_frames,
+    load_calibration,
+)
+from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
+from robotic_discovery_platform_tpu.utils.config import ClientConfig
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class FrameResult:
+    mean_curvature: float
+    max_curvature: float
+    smoothed_mean: float
+    smoothed_max: float
+    status: str
+    mask_coverage: float
+    proc_time_ms: float
+    mask_png: bytes
+    spline_points: np.ndarray  # [N, 3]
+    frame_bgr: np.ndarray | None = None
+
+
+def encode_request(color_bgr: np.ndarray, depth: np.ndarray) -> vision_pb2.AnalysisRequest:
+    import cv2
+
+    ok_c, jpg = cv2.imencode(".jpg", color_bgr)
+    ok_d, png = cv2.imencode(".png", depth)
+    if not (ok_c and ok_d):
+        raise ValueError("frame encode failed")
+    h, w = color_bgr.shape[:2]
+    return vision_pb2.AnalysisRequest(
+        color_image=vision_pb2.Image(data=jpg.tobytes(), width=w, height=h),
+        depth_image=vision_pb2.Image(data=png.tobytes(), width=w, height=h),
+    )
+
+
+def generate_requests(source: FrameSource, frame_queue: deque,
+                      max_frames: int | None = None):
+    for color, depth in iter_frames(source, max_frames):
+        frame_queue.append(color)
+        yield encode_request(color, depth)
+
+
+def overlay(frame_bgr: np.ndarray, result: FrameResult,
+            intrinsics: np.ndarray | None, dist: np.ndarray | None) -> np.ndarray:
+    """Red mask blend + green reprojected spline + smoothed curvature text
+    (reference: client.py:110-136)."""
+    import cv2
+
+    vis = frame_bgr.copy()
+    if result.mask_png:
+        mask = cv2.imdecode(np.frombuffer(result.mask_png, np.uint8),
+                            cv2.IMREAD_GRAYSCALE)
+        if mask is not None and mask.shape == vis.shape[:2]:
+            red = np.zeros_like(vis)
+            red[..., 2] = mask
+            vis = cv2.addWeighted(vis, 1.0, red, 0.4, 0)
+    if intrinsics is not None and len(result.spline_points):
+        pts, _ = cv2.projectPoints(
+            result.spline_points.astype(np.float64),
+            np.zeros(3), np.zeros(3),
+            intrinsics, dist if dist is not None else np.zeros(5),
+        )
+        cv2.polylines(vis, [pts.astype(np.int32).reshape(-1, 1, 2)], False,
+                      (0, 255, 0), 2)
+    cv2.putText(
+        vis,
+        f"mean k: {result.smoothed_mean:.3f}  max k: {result.smoothed_max:.3f}",
+        (10, 30), cv2.FONT_HERSHEY_SIMPLEX, 0.8, (255, 255, 255), 2,
+    )
+    return vis
+
+
+def run_client(
+    cfg: ClientConfig = ClientConfig(),
+    source: FrameSource | None = None,
+    max_frames: int | None = None,
+    display: bool = False,
+    channel: grpc.Channel | None = None,
+) -> list[FrameResult]:
+    """Stream frames, return per-frame results. ``display=True`` opens the
+    live overlay window ('q' quits, reference client.py:138-140)."""
+    source = source or SyntheticSource()
+    intrinsics = dist = None
+    try:
+        intrinsics, dist, _ = load_calibration(cfg.calibration_path)
+    except (FileNotFoundError, KeyError):
+        if isinstance(source, SyntheticSource):
+            intrinsics = source.intrinsics()
+        log.warning("no calibration file at %s", cfg.calibration_path)
+
+    own_channel = channel is None
+    if channel is None:
+        channel = grpc.insecure_channel(cfg.server_address)
+    stub = vision_grpc.VisionAnalysisServiceStub(channel)
+
+    frame_queue: deque = deque(maxlen=cfg.frame_queue_len)
+    mean_window: deque = deque(maxlen=cfg.smoothing_window)
+    max_window: deque = deque(maxlen=cfg.smoothing_window)
+    results: list[FrameResult] = []
+
+    source.start()
+    try:
+        responses = stub.AnalyzeActuatorPerformance(
+            generate_requests(source, frame_queue, max_frames)
+        )
+        for response in responses:
+            frame = frame_queue.popleft() if frame_queue else None
+            mean_window.append(response.mean_curvature)
+            max_window.append(response.max_curvature)
+            result = FrameResult(
+                mean_curvature=response.mean_curvature,
+                max_curvature=response.max_curvature,
+                smoothed_mean=float(np.mean(mean_window)),
+                smoothed_max=float(np.mean(max_window)),
+                status=response.status,
+                mask_coverage=response.mask_coverage,
+                proc_time_ms=response.proc_time_ms,
+                mask_png=response.mask,
+                spline_points=np.array(
+                    [[p.x, p.y, p.z] for p in response.spline_points]
+                ).reshape(-1, 3),
+                frame_bgr=frame,
+            )
+            results.append(result)
+            if display and frame is not None:
+                import cv2
+
+                cv2.imshow("Actuator Analysis (TPU)",
+                           overlay(frame, result, intrinsics, dist))
+                if cv2.waitKey(1) & 0xFF == ord("q"):
+                    break
+    except grpc.RpcError as exc:
+        log.error("rpc failed (%s) -- is the server running at %s?",
+                  exc.code() if hasattr(exc, "code") else exc,
+                  cfg.server_address)
+        raise
+    finally:
+        source.stop()
+        if display:
+            import cv2
+
+            cv2.destroyAllWindows()
+        if own_channel:
+            channel.close()
+    return results
+
+
+if __name__ == "__main__":
+    from robotic_discovery_platform_tpu.utils.config import parse_config
+
+    run_client(parse_config().client, display=True)

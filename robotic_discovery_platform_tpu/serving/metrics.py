@@ -1,0 +1,63 @@
+"""Per-frame metrics CSV -- the monitoring data contract.
+
+Schema is byte-compatible with the reference
+(``timestamp,mean_curvature,max_curvature,mask_coverage_percent``,
+reference: services/vision_analysis/server.py:68-72,146-150): the drift
+detector consumes exactly these columns. Two reference defects fixed
+(SURVEY.md section 5.2): the reference re-opens the file for every frame and
+interleaves appends from up to 10 gRPC worker threads with no lock; here a
+single writer object owns the handle, buffers rows, and flushes under a lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+
+HEADER = "timestamp,mean_curvature,max_curvature,mask_coverage_percent"
+
+
+class MetricsWriter:
+    def __init__(self, path: str | Path, flush_every: int = 32,
+                 flush_interval_s: float = 2.0):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.flush_every = max(1, flush_every)
+        self.flush_interval_s = flush_interval_s
+        self._lock = threading.Lock()
+        self._buf: list[str] = []
+        self._last_flush = time.monotonic()
+        if not self.path.exists():
+            self.path.write_text(HEADER + "\n")
+
+    def append(self, mean_curvature: float, max_curvature: float,
+               mask_coverage_percent: float, timestamp: str | None = None) -> None:
+        ts = timestamp or datetime.now(timezone.utc).strftime(
+            "%Y-%m-%d %H:%M:%S.%f"
+        )
+        row = f"{ts},{mean_curvature},{max_curvature},{mask_coverage_percent}"
+        with self._lock:
+            self._buf.append(row)
+            due = (
+                len(self._buf) >= self.flush_every
+                or time.monotonic() - self._last_flush > self.flush_interval_s
+            )
+            if due:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._buf:
+            return
+        with open(self.path, "a") as f:
+            f.write("\n".join(self._buf) + "\n")
+        self._buf.clear()
+        self._last_flush = time.monotonic()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        self.flush()

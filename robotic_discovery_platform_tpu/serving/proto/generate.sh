@@ -1,0 +1,9 @@
+#!/bin/sh
+# Regenerate vision_pb2.py from protos/vision.proto.
+# (grpc_tools is not available in this image, so the gRPC glue is the
+# handwritten vision_grpc.py -- only the message module is generated.)
+set -e
+cd "$(dirname "$0")/../../.."
+protoc --python_out=robotic_discovery_platform_tpu/serving/proto \
+    --proto_path=protos protos/vision.proto
+echo "generated robotic_discovery_platform_tpu/serving/proto/vision_pb2.py"

@@ -1,0 +1,220 @@
+"""The vision analysis gRPC server, TPU-backed.
+
+Capability-parity rebuild of the reference server (reference:
+services/vision_analysis/server.py): same wire contract, same insecure-port
+serving loop, same metrics CSV, same registry-driven model resolution --
+with the compute path swapped for the fused XLA graph (ops/pipeline.py) and
+the reference's documented-but-missing behaviors implemented:
+
+- the model is resolved through the ``staging`` alias first, falling back to
+  the latest version (README.md:147 documents staging; server.py:81 actually
+  loads /latest -- SURVEY.md section 2.1 "retraining pipeline");
+- ``status``, ``mask_coverage`` and ``proc_time_ms`` response fields are
+  populated for real (declared in the proto but never set by the reference);
+- per-frame errors produce an error-status response and keep the stream
+  alive instead of tearing it down;
+- metrics writes are buffered and thread-safe (serving/metrics.py).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent import futures
+
+import numpy as np
+
+import grpc
+import jax
+
+from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.io.frames import load_calibration
+from robotic_discovery_platform_tpu.ops import pipeline
+from robotic_discovery_platform_tpu.serving.metrics import MetricsWriter
+from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
+from robotic_discovery_platform_tpu.utils.config import (
+    GeometryConfig,
+    ServerConfig,
+)
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def resolve_serving_model(cfg: ServerConfig):
+    """staging alias first, latest fallback. Returns (model, variables)."""
+    tracking.set_tracking_uri(cfg.tracking_uri)
+    alias_uri = f"models:/{cfg.model_name}@{cfg.model_alias}"
+    try:
+        model, variables = tracking.load_model(alias_uri)
+        log.info("loaded %s", alias_uri)
+        return model, variables
+    except (KeyError, FileNotFoundError):
+        latest_uri = f"models:/{cfg.model_name}/latest"
+        model, variables = tracking.load_model(latest_uri)
+        log.info("no %r alias; loaded %s", cfg.model_alias, latest_uri)
+        return model, variables
+
+
+def _default_intrinsics(w: int, h: int) -> np.ndarray:
+    f = 0.94 * w
+    return np.array([[f, 0, w / 2], [0, f, h / 2], [0, 0, 1]], np.float64)
+
+
+class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
+    def __init__(
+        self,
+        model,
+        variables,
+        intrinsics: np.ndarray | None,
+        depth_scale: float,
+        cfg: ServerConfig = ServerConfig(),
+        geom_cfg: GeometryConfig = GeometryConfig(),
+        metrics: MetricsWriter | None = None,
+    ):
+        self.cfg = cfg
+        self.variables = variables
+        self.intrinsics = intrinsics
+        self.depth_scale = depth_scale
+        self.analyze = pipeline.make_frame_analyzer(
+            model, img_size=cfg.model_img_size, geom_cfg=geom_cfg
+        )
+        self.metrics = metrics or MetricsWriter(
+            cfg.metrics_csv, cfg.metrics_flush_every
+        )
+
+    # -- per-frame ----------------------------------------------------------
+
+    def _decode(self, request: vision_pb2.AnalysisRequest):
+        import cv2
+
+        color = cv2.imdecode(
+            np.frombuffer(request.color_image.data, np.uint8), cv2.IMREAD_COLOR
+        )
+        depth = cv2.imdecode(
+            np.frombuffer(request.depth_image.data, np.uint8),
+            cv2.IMREAD_UNCHANGED,
+        )
+        if color is None or depth is None:
+            raise ValueError("failed to decode color/depth payload")
+        if depth.dtype != np.uint16:
+            depth = depth.astype(np.uint16)
+        return color, depth
+
+    def _analyze_frame(self, color_bgr: np.ndarray, depth: np.ndarray):
+        import cv2
+
+        h, w = color_bgr.shape[:2]
+        k = self.intrinsics if self.intrinsics is not None else _default_intrinsics(w, h)
+        out = self.analyze(
+            self.variables,
+            color_bgr[..., ::-1],  # BGR -> RGB
+            depth,
+            np.asarray(k, np.float32),
+            np.float32(self.depth_scale),
+        )
+        # host fetch of the fused result
+        mask = np.asarray(out.mask)
+        coverage = float(out.mask_coverage)
+        prof = out.profile
+        valid = bool(prof.valid)
+        mean_k = float(prof.mean_curvature) if valid else 0.0
+        max_k = float(prof.max_curvature) if valid else 0.0
+        spline = np.asarray(prof.spline_points) if valid else np.zeros((0, 3))
+        ok, mask_png = cv2.imencode(".png", mask * 255)
+        if not ok:
+            raise ValueError("mask encode failed")
+        return mean_k, max_k, spline, mask_png.tobytes(), coverage, valid
+
+    def AnalyzeActuatorPerformance(self, request_iterator, context):
+        for request in request_iterator:
+            t0 = time.perf_counter()
+            try:
+                color, depth = self._decode(request)
+                mean_k, max_k, spline, mask_png, coverage, valid = (
+                    self._analyze_frame(color, depth)
+                )
+                response = vision_pb2.AnalysisResponse(
+                    mean_curvature=mean_k,
+                    max_curvature=max_k,
+                    spline_points=[
+                        vision_pb2.Point3D(x=float(p[0]), y=float(p[1]), z=float(p[2]))
+                        for p in spline
+                    ],
+                    status="OK" if valid else "DEGRADED: insufficient geometry",
+                    mask=mask_png,
+                    mask_coverage=coverage,
+                )
+                self.metrics.append(mean_k, max_k, coverage)
+            except Exception as exc:  # keep the stream alive per frame
+                log.exception("analysis error")
+                response = vision_pb2.AnalysisResponse(
+                    status=f"ERROR: {type(exc).__name__}: {exc}"
+                )
+            response.proc_time_ms = (time.perf_counter() - t0) * 1e3
+            yield response
+        self.metrics.flush()
+
+    def warmup(self, width: int, height: int) -> None:
+        """Pre-compile the fused graph for a camera geometry so the first
+        real frame does not pay XLA compilation."""
+        import cv2
+
+        dummy = np.zeros((height, width, 3), np.uint8)
+        ok, png = cv2.imencode(".png", np.zeros((height, width), np.uint16))
+        req = vision_pb2.AnalysisRequest(
+            color_image=vision_pb2.Image(
+                data=cv2.imencode(".jpg", dummy)[1].tobytes(),
+                width=width, height=height,
+            ),
+            depth_image=vision_pb2.Image(data=png.tobytes(), width=width,
+                                         height=height),
+        )
+        color, depth = self._decode(req)
+        self._analyze_frame(color, depth)
+        log.info("warmed up %dx%d analyzer on %s", width, height,
+                 jax.default_backend())
+
+
+def build_server(
+    cfg: ServerConfig = ServerConfig(),
+    geom_cfg: GeometryConfig = GeometryConfig(),
+    warmup_shape: tuple[int, int] | None = None,
+) -> tuple[grpc.Server, VisionAnalysisService]:
+    """Load every resource and return an unstarted (server, servicer).
+    Aborts (raises) when the model or calibration is unusable, mirroring the
+    reference's fail-fast startup (server.py:168-170)."""
+    model, variables = resolve_serving_model(cfg)
+    intrinsics = None
+    depth_scale = cfg.default_depth_scale
+    try:
+        intrinsics, _, scale = load_calibration(cfg.calibration_path)
+        if scale is not None:
+            depth_scale = scale
+        log.info("calibration loaded from %s", cfg.calibration_path)
+    except (FileNotFoundError, KeyError) as exc:
+        log.warning(
+            "no calibration at %s (%s); using focal-length defaults",
+            cfg.calibration_path, exc,
+        )
+    servicer = VisionAnalysisService(
+        model, variables, intrinsics, depth_scale, cfg, geom_cfg
+    )
+    if warmup_shape is not None:
+        servicer.warmup(*warmup_shape)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=cfg.max_workers))
+    vision_grpc.add_VisionAnalysisServiceServicer_to_server(servicer, server)
+    server.add_insecure_port(cfg.address)
+    return server, servicer
+
+
+def serve(cfg: ServerConfig = ServerConfig(), warmup_shape=(640, 480)) -> None:
+    server, _ = build_server(cfg, warmup_shape=warmup_shape)
+    server.start()
+    log.info("vision analysis server listening on %s", cfg.address)
+    server.wait_for_termination()
+
+
+if __name__ == "__main__":
+    from robotic_discovery_platform_tpu.utils.config import parse_config
+
+    serve(parse_config().server)

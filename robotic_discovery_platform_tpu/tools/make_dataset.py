@@ -1,0 +1,105 @@
+"""Dataset construction: the raw -> labeled step the reference is missing.
+
+The reference README claims the collector "auto-labels" (README.md:48) but no
+raw->processed conversion exists anywhere in its tree (SURVEY.md section 2.1
+"data collector"); the trainer's expected ``ml/datasets/processed/{images,
+masks}`` layout (reference: scripts/train_segmenter.py:54-56) can never be
+produced. This tool provides both ways to close that loop:
+
+- ``synthesize``: generate a fully labeled synthetic dataset
+  (training/synthetic.py) -- zero hardware required;
+- ``pseudo_label``: run a registered model over a raw capture directory and
+  save its masks as labels (model-assisted labeling for the
+  collect -> label -> retrain cycle).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from robotic_discovery_platform_tpu.utils.config import TrainConfig
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def synthesize(out_dir: str | Path, n: int = 200, width: int = 640,
+               height: int = 480, seed: int = 0) -> Path:
+    from robotic_discovery_platform_tpu.training.synthetic import generate_dataset
+
+    out = generate_dataset(out_dir, n, h=height, w=width, seed=seed)
+    log.info("synthesized %d labeled pairs under %s", n, out)
+    return out
+
+
+def pseudo_label(
+    capture_dir: str | Path,
+    out_dir: str | Path,
+    model_uri: str = "models:/Actuator-Segmenter@staging",
+    img_size: int = 256,
+    min_coverage_pct: float = 0.5,
+) -> int:
+    """Label a collector run with a registered model's own predictions.
+    Frames whose predicted mask covers less than ``min_coverage_pct`` of the
+    image are skipped (nothing to learn from). Returns pairs written."""
+    import cv2
+
+    import jax.numpy as jnp
+
+    from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.io.frames import ReplaySource
+    from robotic_discovery_platform_tpu.ops import pipeline
+
+    model, variables = tracking.load_model(model_uri)
+    source = ReplaySource(capture_dir, loop=False)
+    out = Path(out_dir)
+    (out / "images").mkdir(parents=True, exist_ok=True)
+    (out / "masks").mkdir(parents=True, exist_ok=True)
+
+    import jax
+
+    @jax.jit
+    def predict(frame_rgb):
+        x = pipeline.preprocess(frame_rgb[None], img_size)
+        logits = model.apply(variables, x, train=False)
+        return pipeline.logits_to_native_masks(
+            logits, frame_rgb.shape[0], frame_rgb.shape[1]
+        )[0]
+
+    written = 0
+    source.start()
+    for i, (color, _depth) in enumerate(
+        iter(lambda: source.get_frames(), (None, None))
+    ):
+        mask = np.asarray(predict(jnp.asarray(color[..., ::-1])))
+        coverage = 100.0 * mask.mean()
+        if coverage < min_coverage_pct:
+            continue
+        stem = f"labeled_{i:06d}.png"
+        cv2.imwrite(str(out / "images" / stem), color)
+        cv2.imwrite(str(out / "masks" / stem), mask * 255)
+        written += 1
+    log.info("pseudo-labeled %d frames from %s into %s", written,
+             capture_dir, out)
+    return written
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    syn = sub.add_parser("synthesize")
+    syn.add_argument("--out", default=TrainConfig().dataset_dir)
+    syn.add_argument("--n", type=int, default=200)
+    lab = sub.add_parser("pseudo-label")
+    lab.add_argument("capture_dir")
+    lab.add_argument("--out", default=TrainConfig().dataset_dir)
+    lab.add_argument("--model", default="models:/Actuator-Segmenter@staging")
+    args = parser.parse_args()
+    if args.cmd == "synthesize":
+        synthesize(args.out, args.n)
+    else:
+        pseudo_label(args.capture_dir, args.out, args.model)

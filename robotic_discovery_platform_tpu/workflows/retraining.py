@@ -1,0 +1,95 @@
+"""Automated retraining pipeline (L6 orchestration).
+
+Same shape as the reference workflow (reference: workflows/
+retraining_pipeline.py:42-79): run the full trainer, look up the version the
+registry just assigned, promote it to the ``staging`` alias; failures are
+logged, not raised. Because this framework's server actually honors the
+staging alias (serving/server.py), the promotion is load-bearing here --
+in the reference it was decorative (the server read /latest; SURVEY.md
+section 2.1 "retraining pipeline").
+
+Additions: the pipeline can be driven directly by the drift detector
+(``run_if_drifted``), closing the autonomous MLOps loop the reference
+describes but leaves manual (reference README.md:155-169).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.utils.config import (
+    DriftConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class PipelineResult:
+    succeeded: bool
+    version: int | None
+    promoted_alias: str | None
+    message: str
+
+
+def run_retraining_pipeline(
+    cfg: TrainConfig = TrainConfig(),
+    model_cfg: ModelConfig = ModelConfig(),
+    arrays=None,
+    mesh=None,
+    alias: str = "staging",
+) -> PipelineResult:
+    from robotic_discovery_platform_tpu.training.trainer import train_model
+
+    log.info("=== automated retraining pipeline starting ===")
+    try:
+        result = train_model(cfg, model_cfg, arrays=arrays, mesh=mesh)
+        if result.registry_version is None:
+            return PipelineResult(False, None, None,
+                                  "training completed but registered no model")
+        client = tracking.Client()
+        latest = client.get_latest_versions(cfg.registered_model_name,
+                                            stages=["None"])[0]
+        client.set_registered_model_alias(
+            cfg.registered_model_name, alias, latest.version
+        )
+        msg = (
+            f"version {latest.version} of {cfg.registered_model_name!r} "
+            f"promoted to @{alias} (val_loss {result.best_val_loss:.4f})"
+        )
+        log.info(msg)
+        return PipelineResult(True, latest.version, alias, msg)
+    except Exception as exc:
+        # reference behavior: log, do not raise (retraining_pipeline.py:78-79)
+        log.exception("retraining pipeline failed")
+        return PipelineResult(False, None, None, f"{type(exc).__name__}: {exc}")
+
+
+def run_if_drifted(
+    drift_cfg: DriftConfig = DriftConfig(),
+    train_cfg: TrainConfig = TrainConfig(),
+    model_cfg: ModelConfig = ModelConfig(),
+    arrays=None,
+    mesh=None,
+) -> PipelineResult | None:
+    """Drift-gated retraining: the autonomous loop. Returns None when no
+    retraining was needed."""
+    from robotic_discovery_platform_tpu.monitoring.drift import analyze_drift
+
+    report = analyze_drift(drift_cfg)
+    if not (report.analyzed and report.drifted):
+        log.info("no retraining: %s", report.reason)
+        return None
+    log.warning("drift detected (%s); launching retraining", report.reason)
+    return run_retraining_pipeline(train_cfg, model_cfg, arrays=arrays, mesh=mesh)
+
+
+if __name__ == "__main__":
+    from robotic_discovery_platform_tpu.utils.config import parse_config
+
+    pc = parse_config()
+    run_retraining_pipeline(pc.train, pc.model)

@@ -1,0 +1,179 @@
+"""Drift detector, retraining pipeline, and operator-tool tests."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.monitoring import drift
+from robotic_discovery_platform_tpu.utils.config import (
+    CalibrationConfig,
+    CollectConfig,
+    DriftConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from robotic_discovery_platform_tpu.workflows import retraining
+
+
+def _write_metrics(path, coverages):
+    from robotic_discovery_platform_tpu.serving.metrics import HEADER
+
+    rows = [HEADER] + [
+        f"2026-01-01 00:00:{i:02d}.0,0.1,0.2,{c}" for i, c in enumerate(coverages)
+    ]
+    path.write_text("\n".join(rows) + "\n")
+
+
+def test_drift_detected(tmp_path):
+    csv = tmp_path / "m.csv"
+    _write_metrics(csv, [50.0] * 30 + [10.0] * 30)  # 80% drop
+    cfg = DriftConfig(metrics_csv=str(csv),
+                      report_path=str(tmp_path / "r.png"))
+    rep = drift.analyze_drift(cfg)
+    assert rep.analyzed and rep.drifted
+    assert rep.relative_change > 0.25
+    assert (tmp_path / "r.png").exists()
+
+
+def test_no_drift(tmp_path):
+    csv = tmp_path / "m.csv"
+    _write_metrics(csv, [50.0] * 30 + [52.0] * 30)  # 4% change
+    cfg = DriftConfig(metrics_csv=str(csv), report_path=str(tmp_path / "r.png"))
+    rep = drift.analyze_drift(cfg, render=False)
+    assert rep.analyzed and not rep.drifted
+
+
+def test_drift_too_few_rows(tmp_path):
+    csv = tmp_path / "m.csv"
+    _write_metrics(csv, [50.0] * 10)
+    rep = drift.analyze_drift(DriftConfig(metrics_csv=str(csv)), render=False)
+    assert not rep.analyzed and not rep.drifted
+
+
+def test_drift_missing_file(tmp_path):
+    rep = drift.analyze_drift(
+        DriftConfig(metrics_csv=str(tmp_path / "none.csv")), render=False
+    )
+    assert not rep.analyzed
+
+
+@pytest.fixture()
+def train_setup(tmp_path):
+    from robotic_discovery_platform_tpu.training import synthetic
+
+    imgs, masks = synthetic.generate_arrays(8, 32, 32, seed=5)
+    arrays = (imgs.astype(np.float32) / 255.0, masks.astype(np.float32) / 255.0)
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, img_size=32,
+        tracking_uri=f"file:{tmp_path}/mlruns",
+        checkpoint_dir=f"{tmp_path}/ckpt",
+        validation_split=0.25,
+    )
+    return cfg, ModelConfig(base_features=8, compute_dtype="float32"), arrays
+
+
+def test_retraining_pipeline_promotes_staging(train_setup):
+    cfg, model_cfg, arrays = train_setup
+    res = retraining.run_retraining_pipeline(cfg, model_cfg, arrays=arrays)
+    assert res.succeeded
+    assert res.version == 1
+    staged = tracking.Client().get_model_version_by_alias(
+        cfg.registered_model_name, "staging"
+    )
+    assert staged.version == 1
+    # second run promotes version 2
+    res2 = retraining.run_retraining_pipeline(cfg, model_cfg, arrays=arrays)
+    assert res2.version == 2
+    assert tracking.Client().get_model_version_by_alias(
+        cfg.registered_model_name, "staging"
+    ).version == 2
+
+
+def test_retraining_pipeline_logs_not_raises(train_setup):
+    cfg, model_cfg, _ = train_setup
+    bad = dataclasses.replace(cfg, dataset_dir="/nonexistent/path")
+    res = retraining.run_retraining_pipeline(bad, model_cfg, arrays=None)
+    assert not res.succeeded
+    assert "FileNotFoundError" in res.message or "dataset" in res.message
+
+
+def test_drift_gated_retraining(train_setup, tmp_path):
+    cfg, model_cfg, arrays = train_setup
+    csv = tmp_path / "m.csv"
+    _write_metrics(csv, [50.0] * 30 + [5.0] * 30)
+    dcfg = DriftConfig(metrics_csv=str(csv), report_path=str(tmp_path / "r.png"))
+    res = retraining.run_if_drifted(dcfg, cfg, model_cfg, arrays=arrays)
+    assert res is not None and res.succeeded
+    # no drift -> no retraining
+    _write_metrics(csv, [50.0] * 60)
+    assert retraining.run_if_drifted(dcfg, cfg, model_cfg, arrays=arrays) is None
+
+
+def test_collect_and_replay(tmp_path):
+    from robotic_discovery_platform_tpu.io.frames import ReplaySource, SyntheticSource
+    from robotic_discovery_platform_tpu.tools import collect_data
+
+    src = SyntheticSource(width=96, height=64, n_frames=5)
+    run_dir = collect_data.collect(
+        src, CollectConfig(output_root=str(tmp_path)), n_frames=3, interval_s=0.0
+    )
+    replay = ReplaySource(run_dir, loop=False)
+    replay.start()
+    frames = []
+    while True:
+        c, d = replay.get_frames()
+        if c is None:
+            break
+        frames.append((c, d))
+    assert len(frames) == 3
+    assert frames[0][0].shape == (64, 96, 3)
+    assert frames[0][1].dtype == np.uint16
+
+
+def test_calibration_from_synthetic_views():
+    """Render checkerboard views through a known camera; the solver must
+    recover the focal length."""
+    import cv2
+
+    cfg = CalibrationConfig(output_path="unused.npz")
+    cols, rows = cfg.checkerboard_cols, cfg.checkerboard_rows
+    sq = 40  # px per square in the flat pattern
+    pattern = np.zeros(((rows + 1) * sq, (cols + 1) * sq), np.uint8)
+    for r in range(rows + 1):
+        for c in range(cols + 1):
+            if (r + c) % 2 == 0:
+                pattern[r * sq:(r + 1) * sq, c * sq:(c + 1) * sq] = 255
+    pattern = np.pad(pattern, 40, constant_values=128)
+
+    f, w, h = 600.0, 640, 480
+    k = np.array([[f, 0, w / 2], [0, f, h / 2], [0, 0, 1]])
+    rng = np.random.default_rng(0)
+    views = []
+    for _ in range(10):
+        rvec = rng.uniform(-0.25, 0.25, 3)
+        tvec = np.array([
+            rng.uniform(-40, 40), rng.uniform(-40, 40), rng.uniform(420, 560)
+        ])
+        r_mat, _ = cv2.Rodrigues(rvec)
+        # plane points in pattern pixel units, centered
+        hmat = k @ np.column_stack([r_mat[:, 0], r_mat[:, 1], tvec])
+        # map pattern pixel (x, y) -> plane mm-ish coords centered at middle
+        ph, pw = pattern.shape
+        scale = 0.8  # pattern px -> world units
+        pre = np.array([[scale, 0, -scale * pw / 2],
+                        [0, scale, -scale * ph / 2],
+                        [0, 0, 1.0]])
+        warp = hmat @ pre
+        views.append(cv2.warpPerspective(pattern, warp.astype(np.float64),
+                                         (w, h), borderValue=128))
+
+    result = __import__(
+        "robotic_discovery_platform_tpu.tools.calibrate_camera",
+        fromlist=["calibrate_from_images"],
+    ).calibrate_from_images(views, cfg, save=False)
+    assert result.n_views >= cfg.min_captures
+    fx = result.camera_matrix[0, 0]
+    assert abs(fx - f) / f < 0.1, fx
+    assert result.mean_reprojection_error < 1.0
