@@ -47,7 +47,7 @@ class CurvatureProfile(NamedTuple):
     valid: jnp.ndarray  # scalar bool
     num_cloud_points: jnp.ndarray  # scalar int (diagnostics)
     num_edge_points: jnp.ndarray  # scalar int (diagnostics)
-    truncated: jnp.ndarray  # scalar bool: cloud exceeded the max_points budget
+    truncated: jnp.ndarray  # scalar bool: max_points or per-bin budget exceeded
 
 
 def deproject(mask, depth, fx, fy, cx, cy, depth_scale):
@@ -121,18 +121,22 @@ def _edge_points(pts, w_sel, cfg: GeometryConfig):
         yk = jnp.where(in_bin, ys, -big)
         vals, idxs = jax.lax.top_k(yk, cfg.max_per_bin)
         rank = jnp.arange(cfg.max_per_bin)
-        # k_b is implicitly capped at the static max_per_bin budget; with the
-        # default 5% rule that only binds when one bin holds more than
-        # max_per_bin / top_k_percent points (degenerate x-range) -- such
-        # frames also set `truncated` upstream or fail the edge-count minimum.
         keep = (rank < k_b) & (vals > -big)
-        return pts[idxs], keep.astype(jnp.float32)
+        # k_b is capped at the static max_per_bin budget; report when the cap
+        # binds so frames using fewer edge points than the reference's 5%
+        # rule are flagged rather than silent.
+        return pts[idxs], keep.astype(jnp.float32), k_b > cfg.max_per_bin
 
     bins = jnp.arange(cfg.num_bins)
-    e_pts, e_w = jax.vmap(per_bin)(bins)  # [B, K, 3], [B, K]
+    e_pts, e_w, capped = jax.vmap(per_bin)(bins)  # [B, K, 3], [B, K], [B]
     e_pts = e_pts.reshape(-1, 3)
     e_w = e_w.reshape(-1) * binnable.astype(jnp.float32)
-    return e_pts, e_w, jnp.sum(e_w).astype(jnp.int32), binnable
+    # Mask the cap flag by binnable: a frame with a degenerate x-range dumps
+    # everything into bin 0 and is already invalid, not "truncated".
+    return (
+        e_pts, e_w, jnp.sum(e_w).astype(jnp.int32), binnable,
+        jnp.any(capped) & binnable,
+    )
 
 
 def _sort_by_x(pts, w):
@@ -171,7 +175,7 @@ def compute_curvature_profile(
     pts, w_sel = _gather_cloud(x, y, z, valid_map, cfg.max_points)
     cloud_count = jnp.sum(valid_map).astype(jnp.int32)
 
-    e_pts, e_w, edge_count, binnable = _edge_points(pts, w_sel, cfg)
+    e_pts, e_w, edge_count, binnable, bin_capped = _edge_points(pts, w_sel, cfg)
     s_pts, s_w = _sort_by_x(e_pts, e_w)
 
     knots = bspline.clamped_uniform_knots(cfg.num_ctrl, cfg.spline_degree)
@@ -202,7 +206,7 @@ def compute_curvature_profile(
         valid=ok,
         num_cloud_points=cloud_count,
         num_edge_points=edge_count,
-        truncated=cloud_count > budget,
+        truncated=(cloud_count > budget) | bin_capped,
     )
 
 

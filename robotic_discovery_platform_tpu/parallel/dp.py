@@ -81,8 +81,12 @@ def parallelize_training(
         core_train_step,
     )
 
-    tp = mesh.shape["model"] > 1 if tp is None else tp
-    spatial = mesh.shape["spatial"] > 1 if spatial is None else spatial
+    # Treat a missing mesh axis as size 1 so user-supplied meshes with only a
+    # "data" axis default tp/spatial off instead of raising KeyError.
+    tp = dict(mesh.shape).get("model", 1) > 1 if tp is None else tp
+    spatial = (
+        dict(mesh.shape).get("spatial", 1) > 1 if spatial is None else spatial
+    )
 
     state_specs = _state_shardings(mesh, state, tp, tp_min_channels)
     state_shardings = jax.tree.map(

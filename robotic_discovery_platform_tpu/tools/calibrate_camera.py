@@ -83,7 +83,9 @@ def calibrate_from_images(
     total_err = 0.0
     for i in range(len(obj_points)):
         proj, _ = cv2.projectPoints(obj_points[i], rvecs[i], tvecs[i], mtx, dist)
-        total_err += cv2.norm(img_points[i], proj, cv2.NORM_L2) / len(proj)
+        residual = np.asarray(img_points[i], np.float64).reshape(-1, 2) \
+            - np.asarray(proj, np.float64).reshape(-1, 2)
+        total_err += float(np.linalg.norm(residual)) / len(proj)
     mean_err = total_err / len(obj_points)
 
     out_path = None

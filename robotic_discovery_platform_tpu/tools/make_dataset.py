@@ -70,9 +70,12 @@ def pseudo_label(
 
     written = 0
     source.start()
-    for i, (color, _depth) in enumerate(
-        iter(lambda: source.get_frames(), (None, None))
-    ):
+    i = -1
+    while True:
+        color, _depth = source.get_frames()
+        if color is None:
+            break
+        i += 1
         mask = np.asarray(predict(jnp.asarray(color[..., ::-1])))
         coverage = 100.0 * mask.mean()
         if coverage < min_coverage_pct:

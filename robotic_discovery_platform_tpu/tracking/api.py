@@ -222,8 +222,23 @@ class Client:
     """Registry client with the reference's MlflowClient call shapes."""
 
     def get_latest_versions(self, name: str, stages=None) -> list[ModelVersionInfo]:
-        v = _store().latest_version(name)
-        return [ModelVersionInfo(name, v["version"], v.get("run_id"))]
+        """MLflow semantics: latest version per requested stage. A version's
+        stage is "None" until transitioned (the reference promotes via the
+        *alias* flow, retraining_pipeline.py:69-75, so stages stay "None"
+        unless a version record carries an explicit ``stage`` field)."""
+        if stages is None:
+            v = _store().latest_version(name)
+            return [ModelVersionInfo(name, v["version"], v.get("run_id"))]
+        versions = _store().list_model_versions(name)
+        if not versions:
+            raise KeyError(f"registered model {name!r} has no versions")
+        out = []
+        for stage in stages:
+            staged = [v for v in versions if v.get("stage", "None") == stage]
+            if staged:
+                v = max(staged, key=lambda v: v["version"])
+                out.append(ModelVersionInfo(name, v["version"], v.get("run_id")))
+        return out
 
     def set_registered_model_alias(self, name: str, alias: str, version) -> None:
         _store().set_alias(name, alias, int(version))

@@ -106,9 +106,10 @@ class Batches:
         # pad the tail so every batch is full and divisible (wrap-around),
         # keeping jit shapes static
         n_batches = max(1, int(np.ceil(len(order) / b)))
-        need = n_batches * b - len(order)
-        if need:
-            order = np.concatenate([order, order[:need]])
+        if n_batches * b != len(order):
+            # np.resize repeats the permutation cyclically, so splits smaller
+            # than the pad amount still fill every slot
+            order = np.resize(order, n_batches * b)
         return order.reshape(n_batches, b)
 
     def __iter__(self):

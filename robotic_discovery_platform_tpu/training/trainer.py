@@ -173,10 +173,25 @@ def train_model(
     loss_fn = losses_lib.make_loss_fn(cfg.loss, cfg.dice_weight)
     state = create_state(model, tx, jax.random.key(cfg.seed), cfg.img_size)
 
+    best_params = None
+    best_stats = None
+
+    # Checkpoints carry the best-so-far candidate alongside the live state so
+    # a resumed run registers the params that actually achieved
+    # ``best_val_loss``, not whatever the last epoch happened to hold.
     ckpt = CheckpointManager(cfg.checkpoint_dir, keep=cfg.keep_checkpoints)
     if resume and ckpt.latest_step() is not None:
-        state = ckpt.restore(state)
+        template = {
+            "state": state,
+            "best_params": state.params,
+            "best_stats": state.batch_stats,
+        }
+        restored = ckpt.restore(template)
+        state = restored["state"]
         log.info("resumed from checkpoint at epoch %d", int(state.epoch))
+        if np.isfinite(float(state.best_val_loss)):
+            best_params = jax.device_get(restored["best_params"])
+            best_stats = jax.device_get(restored["best_stats"])
 
     if mesh is not None:
         from robotic_discovery_platform_tpu.parallel import parallelize_training
@@ -203,8 +218,6 @@ def train_model(
     tracking.set_tracking_uri(cfg.tracking_uri)
     tracking.set_experiment(cfg.experiment_name)
 
-    best_params = None
-    best_stats = None
     registry_version = None
     final_metrics: dict = {}
 
@@ -273,7 +286,21 @@ def train_model(
                 best_stats = jax.device_get(state.batch_stats)
 
             state = state.replace(epoch=jnp.asarray(epoch + 1, jnp.int32))
-            ckpt.save(epoch + 1, jax.device_get(state))
+            host_state = jax.device_get(state)
+            ckpt.save(
+                epoch + 1,
+                {
+                    "state": host_state,
+                    "best_params": (
+                        best_params if best_params is not None
+                        else host_state.params
+                    ),
+                    "best_stats": (
+                        best_stats if best_stats is not None
+                        else host_state.batch_stats
+                    ),
+                },
+            )
 
         tracking.log_metric("best_val_loss", float(state.best_val_loss))
 
