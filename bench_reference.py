@@ -1,0 +1,225 @@
+"""Measure the reference pipeline's per-stage CPU costs: the comparison
+anchor BASELINE.md:24-29 demands (the reference publishes no numbers).
+
+Reproduces the observable compute path of the reference WITHOUT copying its
+code:
+
+- serving hot loop (reference: services/vision_analysis/server.py:116-152):
+  JPEG/PNG decode -> resize-256 preprocess -> torch U-Net(3,1) forward ->
+  sigmoid/threshold -> nearest-resize mask -> numpy/scipy curvature
+  (tests/oracle.py, written from the SURVEY spec of pkg/geometry_utils.py)
+  -> PNG mask encode;
+- training epoch (reference: scripts/train_segmenter.py:103-210): Adam
+  lr=1e-4, batch 4, BCEWithLogitsLoss, 256x256, forward+backward over the
+  dataset.
+
+The torch U-Net here is written fresh from the architecture spec
+(SURVEY.md section 2.1: DoubleConv = (3x3 conv no-bias -> BN -> ReLU) x 2,
+4x down/up, bilinear decoder with halved mid-channels, channel ladder
+64..1024//2), so parameter count and FLOPs match the deployed reference
+model (pkg/segmentation_model.py:86-120, instantiated UNet(3, 1) at
+train_segmenter.py:143).
+
+Writes BASELINE_MEASURED.json; bench.py reads it to report vs_baseline
+against *measured* reference throughput instead of the design target.
+"""
+
+from __future__ import annotations
+
+import json
+import platform
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+
+def build_torch_unet():
+    """Reference-equivalent torch model from the SURVEY spec (bilinear
+    variant, the deployed configuration)."""
+    import torch
+    import torch.nn as nn
+
+    class DoubleConv(nn.Module):
+        def __init__(self, cin, cout, mid=None):
+            super().__init__()
+            mid = mid or cout
+            self.block = nn.Sequential(
+                nn.Conv2d(cin, mid, 3, padding=1, bias=False),
+                nn.BatchNorm2d(mid), nn.ReLU(inplace=True),
+                nn.Conv2d(mid, cout, 3, padding=1, bias=False),
+                nn.BatchNorm2d(cout), nn.ReLU(inplace=True),
+            )
+
+        def forward(self, x):
+            return self.block(x)
+
+    class Down(nn.Module):
+        def __init__(self, cin, cout):
+            super().__init__()
+            self.block = nn.Sequential(nn.MaxPool2d(2), DoubleConv(cin, cout))
+
+        def forward(self, x):
+            return self.block(x)
+
+    class Up(nn.Module):
+        def __init__(self, cin, cout):
+            super().__init__()
+            self.up = nn.Upsample(scale_factor=2, mode="bilinear",
+                                  align_corners=True)
+            self.conv = DoubleConv(cin, cout, mid=cin // 2)
+
+        def forward(self, x, skip):
+            x = self.up(x)
+            dy = skip.size(2) - x.size(2)
+            dx = skip.size(3) - x.size(3)
+            x = nn.functional.pad(
+                x, [dx // 2, dx - dx // 2, dy // 2, dy - dy // 2]
+            )
+            return self.conv(torch.cat([skip, x], dim=1))
+
+    class UNet(nn.Module):
+        def __init__(self, n_channels=3, n_classes=1):
+            super().__init__()
+            f = 64
+            self.inc = DoubleConv(n_channels, f)
+            self.down1 = Down(f, f * 2)
+            self.down2 = Down(f * 2, f * 4)
+            self.down3 = Down(f * 4, f * 8)
+            self.down4 = Down(f * 8, f * 16 // 2)
+            self.up1 = Up(f * 16, f * 8 // 2)
+            self.up2 = Up(f * 8, f * 4 // 2)
+            self.up3 = Up(f * 4, f * 2 // 2)
+            self.up4 = Up(f * 2, f)
+            self.outc = nn.Conv2d(f, n_classes, 1)
+
+        def forward(self, x):
+            x1 = self.inc(x)
+            x2 = self.down1(x1)
+            x3 = self.down2(x2)
+            x4 = self.down3(x3)
+            x5 = self.down4(x4)
+            y = self.up1(x5, x4)
+            y = self.up2(y, x3)
+            y = self.up3(y, x2)
+            y = self.up4(y, x1)
+            return self.outc(y)
+
+    return UNet()
+
+
+def synthetic_frame(h=480, w=640, seed=0):
+    from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+
+    src = SyntheticSource(width=w, height=h, seed=seed, n_frames=1)
+    src.start()
+    color, depth = src.get_frames()
+    src.stop()
+    return color, depth
+
+
+def bench_serving(n_frames: int = 20) -> dict:
+    import cv2
+    import torch
+
+    from oracle import oracle_curvature
+
+    model = build_torch_unet().eval()
+    color, depth = synthetic_frame()
+    ok1, jpg = cv2.imencode(".jpg", color)
+    ok2, png = cv2.imencode(".png", depth)
+    assert ok1 and ok2
+    h, w = color.shape[:2]
+    intr = np.array([[0.94 * w, 0, w / 2], [0, 0.94 * w, h / 2], [0, 0, 1]])
+
+    stages = {"decode": [], "forward": [], "geometry": [], "encode": []}
+    for i in range(n_frames):
+        t0 = time.perf_counter()
+        c = cv2.imdecode(jpg, cv2.IMREAD_COLOR)
+        d = cv2.imdecode(png, cv2.IMREAD_UNCHANGED)
+        t1 = time.perf_counter()
+        x = cv2.resize(c[..., ::-1], (256, 256),
+                       interpolation=cv2.INTER_AREA).astype(np.float32) / 255.0
+        xt = torch.from_numpy(x.transpose(2, 0, 1))[None]
+        with torch.no_grad():
+            logits = model(xt)
+        mask = (torch.sigmoid(logits)[0, 0] > 0.5).numpy().astype(np.uint8)
+        mask = cv2.resize(mask, (w, h), interpolation=cv2.INTER_NEAREST)
+        t2 = time.perf_counter()
+        oracle_curvature(mask, d, intr, 0.001)
+        t3 = time.perf_counter()
+        cv2.imencode(".png", mask * 255)
+        t4 = time.perf_counter()
+        if i >= 2:  # skip warmup iterations
+            stages["decode"].append(t1 - t0)
+            stages["forward"].append(t2 - t1)
+            stages["geometry"].append(t3 - t2)
+            stages["encode"].append(t4 - t3)
+
+    out = {k: round(float(np.median(v)) * 1e3, 3) for k, v in stages.items()}
+    total = sum(out.values())
+    out["total_ms"] = round(total, 3)
+    out["fps"] = round(1000.0 / total, 3)
+    return out
+
+
+def bench_training(n_images: int = 64, epochs: int = 2) -> dict:
+    import torch
+
+    from robotic_discovery_platform_tpu.training import synthetic
+
+    imgs, masks = synthetic.generate_arrays(n_images, 256, 256, seed=0)
+    x = torch.from_numpy(
+        (imgs.astype(np.float32) / 255.0).transpose(0, 3, 1, 2)
+    )
+    y = torch.from_numpy(
+        (masks.astype(np.float32) / 255.0).transpose(0, 3, 1, 2)
+    )
+    model = build_torch_unet().train()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-4)
+    loss_fn = torch.nn.BCEWithLogitsLoss()
+    times = []
+    for _ in range(epochs):
+        t0 = time.perf_counter()
+        for i in range(0, n_images, 4):
+            opt.zero_grad()
+            loss = loss_fn(model(x[i:i + 4]), y[i:i + 4])
+            loss.backward()
+            opt.step()
+        times.append(time.perf_counter() - t0)
+    epoch_s = min(times)
+    n_params = sum(p.numel() for p in model.parameters())
+    return {
+        "epoch_s": round(epoch_s, 3),
+        "images_per_s": round(n_images / epoch_s, 3),
+        "n_images": n_images,
+        "batch_size": 4,
+        "img_size": 256,
+        "torch_params": int(n_params),
+    }
+
+
+def main() -> None:
+    import torch
+
+    result = {
+        "host": platform.processor() or platform.machine(),
+        "python": platform.python_version(),
+        "torch": torch.__version__,
+        "torch_threads": torch.get_num_threads(),
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "serving_cpu_per_stage": bench_serving(),
+        "training_cpu": bench_training(),
+    }
+    out = REPO / "BASELINE_MEASURED.json"
+    out.write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Fused TPU Pallas kernels (conv-BN-ReLU, transpose-conv, 1x1 head) and the
+Pallas-backed U-Net inference forward. See conv.py for the kernel design and
+unet_infer.py for the per-layer pallas/XLA dispatch policy."""
+
+from robotic_discovery_platform_tpu.ops.pallas.conv import (  # noqa: F401
+    conv1x1,
+    conv1x1_xla,
+    conv3x3_bn_relu,
+    conv3x3_bn_relu_xla,
+    conv_transpose2x2,
+    conv_transpose2x2_xla,
+    fold_batchnorm,
+    use_pallas,
+)
+from robotic_discovery_platform_tpu.ops.pallas.unet_infer import (  # noqa: F401
+    PallasUNet,
+    make_pallas_unet,
+)

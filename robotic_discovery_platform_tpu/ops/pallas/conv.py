@@ -1,0 +1,361 @@
+"""Fused TPU Pallas convolution kernels for the U-Net inference path.
+
+The reference's hot blocks are DoubleConv = (3x3 conv no-bias -> BatchNorm ->
+ReLU) x 2 (reference: pkg/segmentation_model.py:24-40) and the 2x2 stride-2
+transposed conv of the non-bilinear decoder (reference: :54-65). On GPU the
+reference leans on cuDNN; here each conv + folded-BatchNorm + ReLU is ONE
+Pallas kernel:
+
+- the 3x3 SAME conv is expressed as nine shifted ``(tile_h * W, Cin) @
+  (Cin, Cout)`` matmuls accumulated in float32 -- the MXU-native decomposition
+  (no im2col materialization, no gather);
+- the input rides in as an overlapping row slab (halo = 1 row) via
+  ``pl.Element`` block indexing, so the Pallas pipeline DMAs each row of HBM
+  exactly once per tile;
+- inference BatchNorm is folded to a per-channel scale/bias applied in the
+  matmul epilogue together with ReLU, so normalized activations never touch
+  HBM.
+
+Everything accumulates in f32 and stores in the requested compute dtype
+(bf16 by default, matching models/unet.py). The plain-XLA equivalents of
+every kernel live alongside (``*_xla``) as the fallback path and the
+numerics oracle; ``use_pallas()`` picks per-backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def use_pallas() -> bool:
+    """Default policy: compiled Pallas on TPU, XLA fallback elsewhere.
+
+    (Kernels also run under ``interpret=True`` on CPU -- that is the test
+    path, not the serving default.)
+    """
+    return jax.default_backend() == "tpu"
+
+
+def fold_batchnorm(bn_params, bn_stats, eps: float = 1e-5):
+    """Fold inference BatchNorm into per-channel (scale, bias), f32.
+
+    y = (x - mean) / sqrt(var + eps) * gamma + beta
+      = x * scale + bias.
+    """
+    gamma = jnp.asarray(bn_params["scale"], jnp.float32)
+    beta = jnp.asarray(bn_params["bias"], jnp.float32)
+    mean = jnp.asarray(bn_stats["mean"], jnp.float32)
+    var = jnp.asarray(bn_stats["var"], jnp.float32)
+    scale = gamma * jax.lax.rsqrt(var + eps)
+    return scale, beta - mean * scale
+
+
+def _pick_tile(size: int, target: int) -> int:
+    """Largest divisor of ``size`` that is <= target."""
+    t = min(size, target)
+    while size % t:
+        t -= 1
+    return t
+
+
+def _tiles_3x3(h: int, w: int, cin: int, cout: int,
+               in_itemsize: int, out_itemsize: int):
+    """(tile_h, tile_co) under a ~10 MB VMEM budget, counting the halo slab,
+    weight block, f32 accumulator, output block, and the Pallas pipeline's
+    double buffering (x2 on every streamed block)."""
+    budget = 5 * 1024 * 1024
+    tile_co = _pick_tile(cout, 256)
+    while tile_co > 128 and 2 * 9 * cin * tile_co * in_itemsize > budget // 3:
+        tile_co = _pick_tile(cout, tile_co // 2)
+    w_bytes = 2 * 9 * cin * tile_co * in_itemsize
+    tile_h = _pick_tile(h, 64)
+    while tile_h > 1:
+        slab = 2 * (tile_h + 2) * (w + 2) * cin * in_itemsize
+        acc = tile_h * w * tile_co * 4
+        out = 2 * tile_h * w * tile_co * out_itemsize
+        if w_bytes + slab + acc + out <= budget:
+            break
+        tile_h = _pick_tile(h, tile_h // 2)
+    return tile_h, tile_co
+
+
+def _conv3x3_kernel(x_ref, w_ref, sb_ref, o_ref, *, tile_h, width, relu,
+                    dx_major):
+    """One (batch, row-tile, cout-tile) grid step.
+
+    x_ref: [tile_h + 2, W + 2, Cin] halo slab (pl.Element rows) cut from the
+        batch-flattened [B * (H + 2), W + 2, Cin] padded input.
+    w_ref: [3, 3, Cin, tile_co].
+    sb_ref: [2, tile_co] folded scale/bias rows.
+    o_ref: [tile_h, W, tile_co] tile of the [B * H, W, Cout] output.
+
+    Two loop orders, chosen statically (measured on v5e, see
+    tests/test_pallas.py and BENCH notes):
+    - ``dx_major``: one sublane shift per dx (3 total); after flattening rows
+      into the sublane dim the dy offsets are W-aligned slices (an address
+      offset, not a relayout). Wins for narrow feature maps (W <= ~128).
+    - dy-major: nine small shifted patches. Wins for wide maps (W >= ~256)
+      where whole-slab relayouts are the dominant cost.
+    """
+    cin = x_ref.shape[-1]
+    tile_co = o_ref.shape[-1]
+    slab = x_ref[:]
+    acc = jnp.zeros((tile_h * width, tile_co), jnp.float32)
+    if dx_major:
+        for dx in range(3):
+            flat = slab[:, dx:dx + width, :].reshape(
+                (tile_h + 2) * width, cin
+            )
+            for dy in range(3):
+                patch = flat[dy * width:dy * width + tile_h * width]
+                acc = acc + jnp.dot(
+                    patch, w_ref[dy, dx], preferred_element_type=jnp.float32
+                )
+    else:
+        for dy in range(3):
+            for dx in range(3):
+                patch = slab[dy:dy + tile_h, dx:dx + width, :].reshape(
+                    tile_h * width, cin
+                )
+                acc = acc + jnp.dot(
+                    patch, w_ref[dy, dx], preferred_element_type=jnp.float32
+                )
+    y = acc * sb_ref[0:1, :] + sb_ref[1:2, :]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    o_ref[:] = y.reshape(tile_h, width, tile_co).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("relu", "out_dtype", "interpret")
+)
+def conv3x3_bn_relu(
+    x, w, scale, bias, *, relu: bool = True, out_dtype=None,
+    interpret: bool = False,
+):
+    """Fused NHWC 3x3 SAME conv + per-channel scale/bias (+ ReLU).
+
+    The Pallas form of the reference DoubleConv half-block
+    (pkg/segmentation_model.py:33-39: Conv2d(bias=False) -> BatchNorm ->
+    ReLU), with BatchNorm pre-folded via :func:`fold_batchnorm`.
+
+    Args:
+        x: [B, H, W, Cin].
+        w: [3, 3, Cin, Cout] (HWIO, the Flax kernel layout).
+        scale, bias: [Cout] f32 epilogue coefficients.
+        relu: apply max(y, 0) in the epilogue.
+        out_dtype: output dtype (default: x.dtype).
+        interpret: run the Pallas interpreter (CPU tests).
+    """
+    b, h, width, cin = x.shape
+    cout = w.shape[-1]
+    out_dtype = x.dtype if out_dtype is None else out_dtype
+    tile_h, tile_co = _tiles_3x3(
+        h, width, cin, cout, x.dtype.itemsize, jnp.dtype(out_dtype).itemsize
+    )
+
+    # Flatten batch into rows: each image is padded separately, so a halo
+    # slab never crosses an image boundary (row tiles divide H exactly).
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0))).reshape(
+        b * (h + 2), width + 2, cin
+    )
+    w = w.astype(x.dtype)  # MXU-native operand dtype, same as the XLA path
+    sb = jnp.stack([scale, bias]).astype(jnp.float32)  # [2, Cout]
+
+    kern = functools.partial(
+        _conv3x3_kernel, tile_h=tile_h, width=width, relu=relu,
+        dx_major=width <= 192,
+    )
+    tiles = h // tile_h
+    out = pl.pallas_call(
+        kern,
+        grid=(b * tiles, cout // tile_co),
+        in_specs=[
+            pl.BlockSpec(
+                (
+                    pl.Element(tile_h + 2),
+                    pl.Element(width + 2),
+                    pl.Element(cin),
+                ),
+                lambda t, co: (
+                    (t // tiles) * (h + 2) + (t % tiles) * tile_h, 0, 0
+                ),
+            ),
+            pl.BlockSpec((3, 3, cin, tile_co), lambda t, co: (0, 0, 0, co)),
+            pl.BlockSpec((2, tile_co), lambda t, co: (0, co)),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_h, width, tile_co), lambda t, co: (t, 0, co)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, width, cout), out_dtype),
+        interpret=interpret,
+    )(xp, w, sb)
+    return out.reshape(b, h, width, cout)
+
+
+def conv3x3_bn_relu_xla(x, w, scale, bias, *, relu: bool = True,
+                        out_dtype=None):
+    """XLA fallback / numerics oracle for :func:`conv3x3_bn_relu`."""
+    out_dtype = x.dtype if out_dtype is None else out_dtype
+    y = jax.lax.conv_general_dilated(
+        x.astype(x.dtype), w.astype(x.dtype), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    y = y * scale + bias
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(out_dtype)
+
+
+def _conv1x1_kernel(x_ref, w_ref, sb_ref, o_ref, *, relu):
+    """x_ref: [1, tile_h, W, Cin]; w_ref: [Cin, tile_co]."""
+    th, width, cin = x_ref.shape[1:]
+    tile_co = o_ref.shape[-1]
+    y = jnp.dot(
+        x_ref[0].reshape(th * width, cin), w_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+    y = y * sb_ref[0:1, :] + sb_ref[1:2, :]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    o_ref[0] = y.reshape(th, width, tile_co).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("relu", "out_dtype", "interpret")
+)
+def conv1x1(x, w, scale, bias, *, relu: bool = False, out_dtype=None,
+            interpret: bool = False):
+    """Fused NHWC 1x1 conv + scale/bias (+ ReLU): the OutConv head
+    (reference: pkg/segmentation_model.py:78-84) with an identity scale and
+    the conv bias riding in ``bias``."""
+    b, h, width, cin = x.shape
+    cout = w.shape[-1]
+    out_dtype = x.dtype if out_dtype is None else out_dtype
+    tile_co = _pick_tile(cout, 256)
+    budget = 5 * 1024 * 1024
+    tile_h = _pick_tile(h, 128)
+    while tile_h > 1 and 2 * tile_h * width * (
+        cin * x.dtype.itemsize + tile_co * jnp.dtype(out_dtype).itemsize
+    ) + tile_h * width * tile_co * 4 > budget:
+        tile_h = _pick_tile(h, tile_h // 2)
+    w = w.astype(x.dtype)
+    sb = jnp.stack([scale, bias]).astype(jnp.float32)
+
+    kern = functools.partial(_conv1x1_kernel, relu=relu)
+    return pl.pallas_call(
+        kern,
+        grid=(b, h // tile_h, cout // tile_co),
+        in_specs=[
+            pl.BlockSpec(
+                (1, tile_h, width, cin), lambda bi, t, co: (bi, t, 0, 0)
+            ),
+            pl.BlockSpec((cin, tile_co), lambda bi, t, co: (0, co)),
+            pl.BlockSpec((2, tile_co), lambda bi, t, co: (0, co)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile_h, width, tile_co), lambda bi, t, co: (bi, t, 0, co)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, width, cout), out_dtype),
+        interpret=interpret,
+    )(x, w, sb)
+
+
+def conv1x1_xla(x, w, scale, bias, *, relu: bool = False, out_dtype=None):
+    out_dtype = x.dtype if out_dtype is None else out_dtype
+    y = jnp.einsum(
+        "bhwi,io->bhwo", x, w.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    y = y * scale + bias
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(out_dtype)
+
+
+def _convt2x2_kernel(x_ref, w_ref, b_ref, o_ref, *, tile_h, width):
+    """2x2 stride-2 transposed conv: each input pixel spawns a 2x2 output
+    patch, so the kernel is four independent matmuls whose results
+    interleave. x_ref: [1, tile_h, W, Cin]; w_ref: [2, 2, Cin, tile_co]."""
+    cin = x_ref.shape[-1]
+    tile_co = o_ref.shape[-1]
+    x2d = x_ref[0].reshape(tile_h * width, cin)
+
+    def tap(dy, dx):
+        # out[2h+dy, 2w+dx] = x[h, w] @ w[1-dy, 1-dx] -- the spatially
+        # flipped tap, matching lax.conv_transpose/Flax semantics
+        # (verified exact against an f64 oracle).
+        y = jnp.dot(
+            x2d, w_ref[1 - dy, 1 - dx], preferred_element_type=jnp.float32
+        )
+        return y.reshape(tile_h, width, tile_co)
+
+    # interleave columns then rows
+    row0 = jnp.stack([tap(0, 0), tap(0, 1)], axis=2).reshape(
+        tile_h, 2 * width, tile_co
+    )
+    row1 = jnp.stack([tap(1, 0), tap(1, 1)], axis=2).reshape(
+        tile_h, 2 * width, tile_co
+    )
+    out = jnp.stack([row0, row1], axis=1).reshape(
+        2 * tile_h, 2 * width, tile_co
+    )
+    o_ref[0] = (out + b_ref[0:1, :]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def conv_transpose2x2(x, w, bias, *, out_dtype=None, interpret: bool = False):
+    """NHWC 2x2 stride-2 transposed conv + bias: the reference's
+    non-bilinear ``Up`` upsampler (pkg/segmentation_model.py:62-63).
+
+    Args:
+        x: [B, H, W, Cin]; w: [2, 2, Cin, Cout]; bias: [Cout].
+    Returns [B, 2H, 2W, Cout].
+    """
+    b, h, width, cin = x.shape
+    cout = w.shape[-1]
+    out_dtype = x.dtype if out_dtype is None else out_dtype
+    tile_co = _pick_tile(cout, 256)
+    budget = 5 * 1024 * 1024
+    tile_h = _pick_tile(h, 32)
+    while tile_h > 1 and 2 * tile_h * width * (
+        cin * x.dtype.itemsize
+        + 4 * tile_co * jnp.dtype(out_dtype).itemsize
+    ) + 4 * tile_h * width * tile_co * 4 > budget:
+        tile_h = _pick_tile(h, tile_h // 2)
+    w = w.astype(x.dtype)
+    bias2d = jnp.asarray(bias, jnp.float32).reshape(1, cout)
+
+    kern = functools.partial(_convt2x2_kernel, tile_h=tile_h, width=width)
+    return pl.pallas_call(
+        kern,
+        grid=(b, h // tile_h, cout // tile_co),
+        in_specs=[
+            pl.BlockSpec(
+                (1, tile_h, width, cin), lambda bi, t, co: (bi, t, 0, 0)
+            ),
+            pl.BlockSpec((2, 2, cin, tile_co), lambda bi, t, co: (0, 0, 0, co)),
+            pl.BlockSpec((1, tile_co), lambda bi, t, co: (0, co)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 2 * tile_h, 2 * width, tile_co),
+            lambda bi, t, co: (bi, t, 0, co),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, 2 * h, 2 * width, cout), out_dtype),
+        interpret=interpret,
+    )(x, w, bias2d)
+
+
+def conv_transpose2x2_xla(x, w, bias, *, out_dtype=None):
+    out_dtype = x.dtype if out_dtype is None else out_dtype
+    y = jax.lax.conv_transpose(
+        x, w.astype(x.dtype), (2, 2), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+    return (y + jnp.asarray(bias, jnp.float32)).astype(out_dtype)

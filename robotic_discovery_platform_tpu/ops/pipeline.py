@@ -51,11 +51,19 @@ def logits_to_native_masks(logits, h: int, w: int, threshold: float = 0.5):
 
 
 def _analyze_batch(model, variables, frames_rgb, depths, intrinsics,
-                   depth_scales, img_size, geom_cfg, threshold):
-    """Shared core: [B, ...] frames -> FrameAnalysis with leading B."""
+                   depth_scales, img_size, geom_cfg, threshold,
+                   forward=None):
+    """Shared core: [B, ...] frames -> FrameAnalysis with leading B.
+
+    ``forward(variables, x) -> logits`` overrides the model forward; the
+    serving layer passes the Pallas-fused net here (ops/pallas).
+    """
     b, h, w = frames_rgb.shape[0], frames_rgb.shape[1], frames_rgb.shape[2]
     x = preprocess(frames_rgb, img_size)
-    logits = model.apply(variables, x, train=False)
+    if forward is None:
+        logits = model.apply(variables, x, train=False)
+    else:
+        logits = forward(variables, x)
     masks = logits_to_native_masks(logits, h, w, threshold)
 
     def per_frame(mask, depth, k, scale):
@@ -71,6 +79,7 @@ def make_frame_analyzer(
     img_size: int = 256,
     geom_cfg: GeometryConfig = GeometryConfig(),
     threshold: float = 0.5,
+    forward=None,
 ):
     """Build the jitted single-frame fused analyzer.
 
@@ -92,6 +101,7 @@ def make_frame_analyzer(
             img_size,
             geom_cfg,
             threshold,
+            forward,
         )
         return jax.tree.map(lambda a: a[0], out)
 
@@ -103,6 +113,7 @@ def make_batch_analyzer(
     img_size: int = 256,
     geom_cfg: GeometryConfig = GeometryConfig(),
     threshold: float = 0.5,
+    forward=None,
 ):
     """Batched variant for cross-stream micro-batching on one chip: one
     forward pass over [B, H, W, 3], geometry vmapped per frame. The model
@@ -120,7 +131,7 @@ def make_batch_analyzer(
             model, variables, frames_rgb, depths,
             jnp.asarray(intrinsics, jnp.float32),
             jnp.asarray(depth_scales, jnp.float32),
-            img_size, geom_cfg, threshold,
+            img_size, geom_cfg, threshold, forward,
         )
 
     return analyze

@@ -30,6 +30,7 @@ from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.io.frames import load_calibration
 from robotic_discovery_platform_tpu.ops import pipeline
 from robotic_discovery_platform_tpu.serving.metrics import MetricsWriter
+from robotic_discovery_platform_tpu.utils.profiling import StageTimer
 from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
 from robotic_discovery_platform_tpu.utils.config import (
     GeometryConfig,
@@ -75,12 +76,46 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self.variables = variables
         self.intrinsics = intrinsics
         self.depth_scale = depth_scale
+        forward = self._build_forward(model, variables, cfg)
         self.analyze = pipeline.make_frame_analyzer(
-            model, img_size=cfg.model_img_size, geom_cfg=geom_cfg
+            model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
+            forward=forward,
         )
+        self.dispatcher = None
+        if cfg.batch_window_ms > 0:
+            from robotic_discovery_platform_tpu.serving.batching import (
+                BatchDispatcher,
+            )
+
+            batch_analyze = pipeline.make_batch_analyzer(
+                model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
+                forward=forward,
+            )
+            self.dispatcher = BatchDispatcher(
+                lambda frames, depths, intr, scales: batch_analyze(
+                    self.variables, frames, depths, intr, scales
+                ),
+                window_ms=cfg.batch_window_ms,
+                max_batch=cfg.max_batch,
+            )
         self.metrics = metrics or MetricsWriter(
             cfg.metrics_csv, cfg.metrics_flush_every
         )
+
+    @staticmethod
+    def _build_forward(model, variables, cfg: ServerConfig):
+        """Pick the model-forward implementation per ServerConfig.model_forward
+        ("auto" = Pallas-fused kernels on TPU, Flax/XLA otherwise)."""
+        from robotic_discovery_platform_tpu.ops import pallas as pallas_ops
+
+        mode = cfg.model_forward
+        if mode == "flax" or (mode == "auto" and not pallas_ops.use_pallas()):
+            return None
+        if mode not in ("auto", "pallas"):
+            raise ValueError(f"unknown model_forward {mode!r}")
+        pnet = pallas_ops.make_pallas_unet(model, variables)
+        log.info("serving with Pallas-fused U-Net forward")
+        return lambda _variables, x: pnet(x)
 
     # -- per-frame ----------------------------------------------------------
 
@@ -100,38 +135,54 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             depth = depth.astype(np.uint16)
         return color, depth
 
-    def _analyze_frame(self, color_bgr: np.ndarray, depth: np.ndarray):
+    def _analyze_frame(self, color_bgr: np.ndarray, depth: np.ndarray,
+                       timer: StageTimer | None = None):
         import cv2
 
+        timer = timer or StageTimer()
         h, w = color_bgr.shape[:2]
         k = self.intrinsics if self.intrinsics is not None else _default_intrinsics(w, h)
-        out = self.analyze(
-            self.variables,
-            color_bgr[..., ::-1],  # BGR -> RGB
-            depth,
-            np.asarray(k, np.float32),
-            np.float32(self.depth_scale),
-        )
-        # host fetch of the fused result
-        mask = np.asarray(out.mask)
-        coverage = float(out.mask_coverage)
-        prof = out.profile
-        valid = bool(prof.valid)
-        mean_k = float(prof.mean_curvature) if valid else 0.0
-        max_k = float(prof.max_curvature) if valid else 0.0
-        spline = np.asarray(prof.spline_points) if valid else np.zeros((0, 3))
-        ok, mask_png = cv2.imencode(".png", mask * 255)
+        rgb = np.ascontiguousarray(color_bgr[..., ::-1])  # BGR -> RGB
+        with timer.stage("device"):
+            if self.dispatcher is not None:
+                # coalesce with co-arriving frames from other streams
+                out = self.dispatcher.submit(
+                    rgb, depth, np.asarray(k, np.float32), self.depth_scale
+                )
+            else:
+                out = self.analyze(
+                    self.variables,
+                    rgb,
+                    depth,
+                    np.asarray(k, np.float32),
+                    np.float32(self.depth_scale),
+                )
+            # host fetch of the fused result
+            mask = np.asarray(out.mask)
+            coverage = float(out.mask_coverage)
+            prof = out.profile
+            valid = bool(prof.valid)
+            mean_k = float(prof.mean_curvature) if valid else 0.0
+            max_k = float(prof.max_curvature) if valid else 0.0
+            spline = (np.asarray(prof.spline_points) if valid
+                      else np.zeros((0, 3)))
+        with timer.stage("encode"):
+            ok, mask_png = cv2.imencode(".png", mask * 255)
         if not ok:
             raise ValueError("mask encode failed")
         return mean_k, max_k, spline, mask_png.tobytes(), coverage, valid
 
     def AnalyzeActuatorPerformance(self, request_iterator, context):
+        # per-stream stage breakdown (decode / device / encode); summarized
+        # at stream end so proc_time_ms has an explanation in the logs
+        timer = StageTimer()
         for request in request_iterator:
             t0 = time.perf_counter()
             try:
-                color, depth = self._decode(request)
+                with timer.stage("decode"):
+                    color, depth = self._decode(request)
                 mean_k, max_k, spline, mask_png, coverage, valid = (
-                    self._analyze_frame(color, depth)
+                    self._analyze_frame(color, depth, timer)
                 )
                 response = vision_pb2.AnalysisResponse(
                     mean_curvature=mean_k,
@@ -153,6 +204,8 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             response.proc_time_ms = (time.perf_counter() - t0) * 1e3
             yield response
         self.metrics.flush()
+        if timer.totals:
+            log.info("stream stage breakdown: %s", timer.summary())
 
     def warmup(self, width: int, height: int) -> None:
         """Pre-compile the fused graph for a camera geometry so the first
@@ -171,8 +224,27 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         )
         color, depth = self._decode(req)
         self._analyze_frame(color, depth)
+        if self.dispatcher is not None:
+            # pre-compile every micro-batch bucket so a load burst does not
+            # pay XLA compilation mid-stream
+            k = (self.intrinsics if self.intrinsics is not None
+                 else _default_intrinsics(width, height))
+            b = 1
+            while b <= self.cfg.max_batch:
+                self.dispatcher._analyze(
+                    np.zeros((b, height, width, 3), np.uint8),
+                    np.zeros((b, height, width), np.uint16),
+                    np.repeat(np.asarray(k, np.float32)[None], b, 0),
+                    np.full((b,), self.depth_scale, np.float32),
+                )
+                b *= 2
         log.info("warmed up %dx%d analyzer on %s", width, height,
                  jax.default_backend())
+
+    def close(self) -> None:
+        if self.dispatcher is not None:
+            self.dispatcher.stop()
+        self.metrics.flush()
 
 
 def build_server(
@@ -208,10 +280,13 @@ def build_server(
 
 
 def serve(cfg: ServerConfig = ServerConfig(), warmup_shape=(640, 480)) -> None:
-    server, _ = build_server(cfg, warmup_shape=warmup_shape)
+    server, servicer = build_server(cfg, warmup_shape=warmup_shape)
     server.start()
     log.info("vision analysis server listening on %s", cfg.address)
-    server.wait_for_termination()
+    try:
+        server.wait_for_termination()
+    finally:
+        servicer.close()
 
 
 if __name__ == "__main__":

@@ -130,6 +130,10 @@ class ServerConfig:
     metrics_csv: str = "logs/vision_service_metrics.csv"
     metrics_flush_every: int = 32
     batch_window_ms: float = 0.0  # >0 enables cross-stream micro-batching
+    max_batch: int = 8  # per-dispatch cap when micro-batching
+    # Model forward implementation: "auto" = Pallas-fused kernels on TPU,
+    # Flax/XLA elsewhere; "flax" / "pallas" force one path (ops/pallas).
+    model_forward: str = "auto"
 
 
 @dataclass(frozen=True)

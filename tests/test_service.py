@@ -166,3 +166,80 @@ def test_metrics_writer_thread_safety(tmp_path):
     lines = open(tmp_path / "m.csv").read().strip().splitlines()
     assert len(lines) == 1 + 8 * 50
     assert all(len(l.split(",")) == 4 for l in lines[1:])
+
+
+@pytest.fixture()
+def batching_server(registered_model, tmp_path):
+    """Server with cross-stream micro-batching enabled (the round-1 dead
+    knob, now live: ServerConfig.batch_window_ms > 0)."""
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=registered_model,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        metrics_flush_every=1,
+        calibration_path=str(tmp_path / "missing.npz"),
+        batch_window_ms=15.0,
+        max_batch=4,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    yield f"localhost:{port}", cfg, servicer
+    server.stop(grace=None)
+    servicer.close()
+
+
+def test_concurrent_streams_micro_batch(batching_server):
+    """Two concurrent client streams are served through the batch dispatcher
+    and both get correct per-frame results."""
+    import threading
+
+    address, _, servicer = batching_server
+    assert servicer.dispatcher is not None
+    results = {}
+
+    def one_stream(seed):
+        source = SyntheticSource(width=160, height=120, seed=seed, n_frames=5)
+        results[seed] = client_lib.run_client(
+            ClientConfig(server_address=address,
+                         calibration_path="none.npz"),
+            source=source, max_frames=5,
+        )
+
+    threads = [threading.Thread(target=one_stream, args=(s,)) for s in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert set(results) == {1, 2}
+    for rs in results.values():
+        assert len(rs) == 5
+        for r in rs:
+            assert r.status.startswith(("OK", "DEGRADED"))
+            assert 0.0 <= r.mask_coverage <= 100.0
+
+
+def test_batched_results_match_single_frame(batching_server, registered_model,
+                                            tmp_path):
+    """A frame analyzed through the dispatcher equals the same frame through
+    the single-frame path."""
+    _, _, servicer = batching_server
+    source = SyntheticSource(width=160, height=120, seed=3, n_frames=1)
+    source.start()
+    color, depth = source.get_frames()
+    source.stop()
+    rgb = np.ascontiguousarray(color[..., ::-1])
+    k = server_lib._default_intrinsics(160, 120).astype(np.float32)
+    batched = servicer.dispatcher.submit(rgb, depth, k, 0.001)
+    single = servicer.analyze(
+        servicer.variables, rgb, depth, k, np.float32(0.001)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(batched.mask), np.asarray(single.mask)
+    )
+    assert float(batched.mask_coverage) == pytest.approx(
+        float(single.mask_coverage), abs=1e-4
+    )
+    assert float(batched.profile.mean_curvature) == pytest.approx(
+        float(single.profile.mean_curvature), rel=1e-4, abs=1e-6
+    )
