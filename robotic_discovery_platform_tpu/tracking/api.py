@@ -53,10 +53,24 @@ def get_tracking_uri() -> str:
     return _globals().uri
 
 
+def _make_store(uri: str):
+    """URI-scheme backend selection: the dependency-free FileStore by
+    default, the real-MLflow adapter for server/databricks URIs or any URI
+    prefixed ``mlflow+`` (see tracking/mlflow_backend.py)."""
+    scheme = uri.split(":", 1)[0]
+    if scheme in ("http", "https") or uri.startswith(("databricks", "mlflow+")):
+        from robotic_discovery_platform_tpu.tracking.mlflow_backend import (
+            MlflowStore)
+
+        return MlflowStore(uri[len("mlflow+"):] if uri.startswith("mlflow+")
+                           else uri)
+    return FileStore(uri)
+
+
 def _store() -> FileStore:
     with _state_lock:
         if _state.store is None:
-            _state.store = FileStore(_state.uri)
+            _state.store = _make_store(_state.uri)
         return _state.store
 
 
@@ -171,11 +185,15 @@ def log_model(variables, model_cfg: ModelConfig, artifact_path: str = "model",
     Returns the new registry version when registered.
     """
     run_id = _require_run()
-    dest = _store().artifact_dir(run_id) / artifact_path
+    store = _store()
+    dest = store.artifact_dir(run_id) / artifact_path
     save_model(variables, model_cfg, dest)
+    # remote backends (MlflowStore) stage locally, then upload to the run
+    if hasattr(store, "publish_artifacts"):
+        store.publish_artifacts(run_id, dest)
     if registered_model_name is None:
         return None
-    return _store().create_model_version(registered_model_name, run_id, dest)
+    return store.create_model_version(registered_model_name, run_id, dest)
 
 
 _MODEL_URI = re.compile(

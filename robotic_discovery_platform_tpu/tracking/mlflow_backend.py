@@ -1,0 +1,171 @@
+"""Real-MLflow backend for the tracking API (optional extra).
+
+The framework's default tracking store is the dependency-free ``FileStore``
+(store.py) exposing the MLflow call surface the reference exercises. This
+module provides the same store protocol backed by an *actual* MLflow
+tracking server / registry, so deployments already running MLflow (the
+reference's setup: scripts/train_segmenter.py:112-129,195-207, browsed via
+``mlflow ui`` per its README) can point the framework at it unchanged.
+
+Backend selection is by tracking URI (see ``api._make_store``):
+
+- ``file:...``            -> FileStore (default, no extra deps)
+- ``http(s)://...``       -> MlflowStore against a tracking server
+- ``databricks...``       -> MlflowStore
+- ``mlflow+<uri>``        -> MlflowStore against any MLflow-supported URI
+                             (e.g. ``mlflow+file:ml/mlruns`` uses MLflow's
+                             own local file store -- handy for ``mlflow ui``)
+
+Requires the ``mlflow`` extra (pyproject.toml); importing this module
+without mlflow installed raises a clear ImportError.
+
+Artifact flow: the api writes model files into a local scratch dir
+(``artifact_dir``), then ``publish_artifacts`` uploads them to the run, and
+``create_model_version`` registers ``runs:/<run_id>/<artifact_path>`` --
+exactly the reference's ``mlflow.pytorch.log_model(...,
+registered_model_name=...)`` shape. ``version_path`` downloads a registry
+version's artifacts so ``load_model("models:/Name@staging")`` works
+identically over both backends.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from pathlib import Path
+
+try:
+    import mlflow
+    from mlflow.exceptions import MlflowException
+    from mlflow.tracking import MlflowClient
+except ImportError as e:  # pragma: no cover - exercised only without mlflow
+    raise ImportError(
+        "the real-MLflow tracking backend needs the 'mlflow' extra "
+        "(pip install robotic-discovery-platform-tpu[mlflow]); the default "
+        "file: backend has no such dependency"
+    ) from e
+
+
+class MlflowStore:
+    """FileStore-protocol adapter over a real MLflow client."""
+
+    def __init__(self, uri: str):
+        self.uri = uri
+        self.client = MlflowClient(tracking_uri=uri, registry_uri=uri)
+        self._scratch = Path(tempfile.mkdtemp(prefix="rdp-mlflow-artifacts-"))
+
+    # -- experiments / runs -------------------------------------------------
+
+    def get_or_create_experiment(self, name: str) -> str:
+        exp = self.client.get_experiment_by_name(name)
+        if exp is not None:
+            return exp.experiment_id
+        return self.client.create_experiment(name)
+
+    def create_run(self, experiment_id: str, run_name: str | None = None) -> str:
+        tags = {"mlflow.runName": run_name} if run_name else {}
+        return self.client.create_run(experiment_id, tags=tags).info.run_id
+
+    def end_run(self, run_id: str, status: str = "FINISHED") -> None:
+        self.client.set_terminated(run_id, status=status)
+
+    def get_run(self, run_id: str) -> dict:
+        run = self.client.get_run(run_id)
+        return {
+            "run_id": run_id,
+            "experiment_id": run.info.experiment_id,
+            "status": run.info.status,
+        }
+
+    # -- params / metrics ---------------------------------------------------
+
+    def log_params(self, run_id: str, params: dict) -> None:
+        for k, v in params.items():
+            self.client.log_param(run_id, k, v)
+
+    def get_params(self, run_id: str) -> dict:
+        return dict(self.client.get_run(run_id).data.params)
+
+    def log_metric(self, run_id: str, key: str, value: float,
+                   step: int | None = None) -> None:
+        self.client.log_metric(run_id, key, float(value),
+                               step=0 if step is None else int(step))
+
+    def get_metric_history(self, run_id: str, key: str) -> list[dict]:
+        return [
+            {"step": m.step, "value": m.value, "timestamp": m.timestamp}
+            for m in self.client.get_metric_history(run_id, key)
+        ]
+
+    # -- artifacts / registry ----------------------------------------------
+
+    def artifact_dir(self, run_id: str) -> Path:
+        """Local staging dir; finalized by ``publish_artifacts``."""
+        d = self._scratch / run_id
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def publish_artifacts(self, run_id: str, local_dir: Path) -> None:
+        local_dir = Path(local_dir)
+        self.client.log_artifacts(run_id, str(local_dir),
+                                  artifact_path=local_dir.name)
+
+    def create_model_version(self, name: str, run_id: str | None,
+                             artifact_dir: Path) -> int:
+        # Client-side registration against self.uri. The fluent
+        # ``mlflow.register_model`` would resolve the *process-global*
+        # tracking URI (never set by this adapter) and miss the configured
+        # backend entirely.
+        source = (f"{self.client.get_run(run_id).info.artifact_uri}/"
+                  f"{Path(artifact_dir).name}")
+        try:
+            self.client.create_registered_model(name)
+        except MlflowException:
+            pass  # already registered
+        version = self.client.create_model_version(name, source=source,
+                                                   run_id=run_id)
+        return int(version.version)
+
+    def list_model_versions(self, name: str) -> list[dict]:
+        versions = self.client.search_model_versions(f"name='{name}'")
+        return sorted(
+            (
+                {
+                    "version": int(v.version),
+                    "run_id": v.run_id,
+                    "stage": getattr(v, "current_stage", None) or "None",
+                }
+                for v in versions
+            ),
+            key=lambda v: v["version"],
+        )
+
+    def latest_version(self, name: str) -> dict:
+        versions = self.list_model_versions(name)
+        if not versions:
+            raise KeyError(f"registered model {name!r} has no versions")
+        return versions[-1]
+
+    def set_alias(self, name: str, alias: str, version: int) -> None:
+        self.client.set_registered_model_alias(name, alias, str(version))
+
+    def get_alias(self, name: str, alias: str) -> int | None:
+        try:
+            v = self.client.get_model_version_by_alias(name, alias)
+        except MlflowException as e:
+            # only "no such alias/model" means None; connectivity/auth
+            # failures must surface, not masquerade as a missing alias
+            if e.error_code in ("RESOURCE_DOES_NOT_EXIST",
+                                "INVALID_PARAMETER_VALUE"):
+                return None
+            raise
+        return int(v.version)
+
+    def version_path(self, name: str, version: int) -> Path:
+        """Download the registry version's model artifacts to a local dir."""
+        dest = self._scratch / "downloads" / name / str(version)
+        dest.mkdir(parents=True, exist_ok=True)
+        source = self.client.get_model_version(name, str(version)).source
+        local = mlflow.artifacts.download_artifacts(
+            artifact_uri=source, dst_path=str(dest), tracking_uri=self.uri
+        )
+        return Path(local)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 import numpy as np
@@ -78,6 +79,74 @@ def train_val_split(n: int, val_fraction: float, seed: int = 0):
     return order[n_val:], order[:n_val]
 
 
+def _check_divisor(batch_size: int, divisor: int) -> None:
+    if divisor > 1 and batch_size % divisor:
+        raise ValueError(
+            f"batch_size {batch_size} must be divisible by the "
+            f"data-parallel world size {divisor}"
+        )
+
+
+def epoch_order(n: int, batch_size: int, shuffle: bool,
+                rng: np.random.Generator) -> np.ndarray:
+    """(n_batches, batch_size) index matrix covering [0, n) with wrap-around
+    tail padding so every batch is full — jit shapes stay static."""
+    order = np.arange(n)
+    if shuffle:
+        rng.shuffle(order)
+    n_batches = max(1, int(np.ceil(n / batch_size)))
+    if n_batches * batch_size != n:
+        # np.resize repeats the permutation cyclically, so splits smaller
+        # than the pad amount still fill every slot
+        order = np.resize(order, n_batches * batch_size)
+    return order.reshape(n_batches, batch_size)
+
+
+def _prefetched(producer_batches, make_item, prefetch: int):
+    """Run ``make_item`` over ``producer_batches`` in a daemon thread, keeping
+    up to ``prefetch`` finished batches queued ahead of the consumer.
+
+    Producer errors re-raise on the consumer side; if the consumer abandons
+    the iterator mid-epoch (train step raised, caller broke out), the
+    ``cancel`` event unblocks the producer so the thread and its queued
+    batches are released instead of pinned for the process lifetime."""
+    q: queue.Queue = queue.Queue(maxsize=prefetch)
+    stop = object()
+    cancel = threading.Event()
+    err: list[BaseException] = []
+
+    def _put(item) -> bool:
+        while not cancel.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for b in producer_batches:
+                if cancel.is_set() or not _put(make_item(b)):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            _put(stop)
+
+    threading.Thread(target=producer, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is stop:
+                if err:
+                    raise err[0]
+                break
+            yield item
+    finally:
+        cancel.set()
+
+
 class Batches:
     """Epoch iterator over in-memory arrays with shuffling, optional
     divisibility padding, and background prefetch."""
@@ -86,53 +155,76 @@ class Batches:
                  seed: int = 0, divisor: int = 1, prefetch: int = 2):
         if len(xs) == 0:
             raise ValueError("empty dataset")
-        if divisor > 1 and batch_size % divisor:
-            raise ValueError(
-                f"batch_size {batch_size} must be divisible by the "
-                f"data-parallel world size {divisor}"
-            )
+        _check_divisor(batch_size, divisor)
         self.xs, self.ys = xs, ys
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.rng = np.random.default_rng(seed)
-        self.divisor = divisor
         self.prefetch = prefetch
 
-    def _epoch_order(self):
-        order = np.arange(len(self.xs))
-        if self.shuffle:
-            self.rng.shuffle(order)
-        b = self.batch_size
-        # pad the tail so every batch is full and divisible (wrap-around),
-        # keeping jit shapes static
-        n_batches = max(1, int(np.ceil(len(order) / b)))
-        if n_batches * b != len(order):
-            # np.resize repeats the permutation cyclically, so splits smaller
-            # than the pad amount still fill every slot
-            order = np.resize(order, n_batches * b)
-        return order.reshape(n_batches, b)
-
     def __iter__(self):
-        batches = self._epoch_order()
+        batches = epoch_order(len(self.xs), self.batch_size, self.shuffle,
+                              self.rng)
         if self.prefetch <= 0:
             for idx in batches:
                 yield self.xs[idx], self.ys[idx]
             return
-        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
-        stop = object()
-
-        def producer():
-            for idx in batches:
-                q.put((self.xs[idx], self.ys[idx]))
-            q.put(stop)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            yield item
+        yield from _prefetched(
+            batches, lambda idx: (self.xs[idx], self.ys[idx]), self.prefetch
+        )
 
     def __len__(self):
         return max(1, int(np.ceil(len(self.xs) / self.batch_size)))
+
+
+class StreamingBatches:
+    """Decode-on-the-fly epoch iterator over a file-backed dataset subset.
+
+    Constant-memory replacement for ``dataset.as_arrays()`` + ``Batches``:
+    only ``prefetch + 1`` decoded batches exist at any moment, so dataset
+    size is bounded by disk, not host RAM. A thread pool decodes/resizes the
+    next batches (``load`` is OpenCV → releases the GIL) while the device
+    runs the current step — the async host input pipeline the reference
+    lacks (its loader is synchronous in-loop with ``num_workers=0``,
+    train_segmenter.py:138-139; SURVEY.md Phase 5 "per-host sharded input
+    pipeline").
+
+    Same epoch semantics as ``Batches``: shuffled wrap-around-padded full
+    batches, divisor-aware for data-parallel sharding.
+    """
+
+    def __init__(self, dataset: PairedSegmentationData, indices,
+                 batch_size: int, shuffle: bool = True, seed: int = 0,
+                 divisor: int = 1, prefetch: int = 2, workers: int = 4):
+        indices = np.asarray(indices)
+        if len(indices) == 0:
+            raise ValueError("empty dataset subset")
+        _check_divisor(batch_size, divisor)
+        self.dataset = dataset
+        self.names = [dataset.names[i] for i in indices]
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = np.random.default_rng(seed)
+        self.prefetch = max(1, prefetch)
+        self.workers = max(1, workers)
+
+    def _decode_batch(self, pool: ThreadPoolExecutor, idx: np.ndarray):
+        s = self.dataset.img_size
+        xs = np.empty((len(idx), s, s, 3), np.float32)
+        ys = np.empty((len(idx), s, s, 1), np.float32)
+        loaded = pool.map(self.dataset.load, (self.names[i] for i in idx))
+        for i, (x, y) in enumerate(loaded):
+            xs[i], ys[i] = x, y
+        return xs, ys
+
+    def __iter__(self):
+        batches = epoch_order(len(self.names), self.batch_size, self.shuffle,
+                              self.rng)
+        with ThreadPoolExecutor(max_workers=self.workers) as pool:
+            yield from _prefetched(
+                batches, lambda idx: self._decode_batch(pool, idx),
+                self.prefetch,
+            )
+
+    def __len__(self):
+        return max(1, int(np.ceil(len(self.names) / self.batch_size)))

@@ -159,11 +159,15 @@ def train_model(
 
     if arrays is not None:
         xs, ys = arrays
+        n_samples = len(xs)
+        ds = None
     else:
+        # file-backed: decoded batch-by-batch by StreamingBatches below, so
+        # dataset size is bounded by disk, not host RAM
         ds = data_lib.PairedSegmentationData(cfg.dataset_dir, cfg.img_size)
-        xs, ys = ds.as_arrays()
+        n_samples = len(ds)
     train_idx, val_idx = data_lib.train_val_split(
-        len(xs), cfg.validation_split, cfg.seed
+        n_samples, cfg.validation_split, cfg.seed
     )
     if len(val_idx) == 0:
         raise ValueError("dataset too small for a validation split")
@@ -207,13 +211,24 @@ def train_model(
     # round the global batch up to a multiple of the data-parallel world size
     # so every jit-sharded batch divides evenly over the mesh
     batch_size = ((max(cfg.batch_size, divisor) + divisor - 1) // divisor) * divisor
-    train_batches = data_lib.Batches(
-        xs[train_idx], ys[train_idx], batch_size, shuffle=True,
-        seed=cfg.seed, divisor=divisor,
-    )
-    val_batches = data_lib.Batches(
-        xs[val_idx], ys[val_idx], batch_size, shuffle=False, divisor=divisor
-    )
+    if ds is not None:
+        train_batches = data_lib.StreamingBatches(
+            ds, train_idx, batch_size, shuffle=True, seed=cfg.seed,
+            divisor=divisor, workers=cfg.loader_workers,
+        )
+        val_batches = data_lib.StreamingBatches(
+            ds, val_idx, batch_size, shuffle=False, divisor=divisor,
+            workers=cfg.loader_workers,
+        )
+    else:
+        train_batches = data_lib.Batches(
+            xs[train_idx], ys[train_idx], batch_size, shuffle=True,
+            seed=cfg.seed, divisor=divisor,
+        )
+        val_batches = data_lib.Batches(
+            xs[val_idx], ys[val_idx], batch_size, shuffle=False,
+            divisor=divisor,
+        )
 
     tracking.set_tracking_uri(cfg.tracking_uri)
     tracking.set_experiment(cfg.experiment_name)

@@ -72,6 +72,8 @@ class TrainConfig:
     # TPU-first:
     donate_state: bool = True
     log_every: int = 1
+    # decode threads for the streaming file loader (StreamingBatches)
+    loader_workers: int = 4
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,88 @@
+"""One process of a 2-process CPU "cluster" for tests/test_multihost.py.
+
+Exercises the real multi-host bring-up path the reference never had
+(SURVEY.md section 5.8): ``jax.distributed.initialize`` via
+``parallel.mesh.initialize_distributed``, a global mesh spanning both
+processes' devices, and one data-parallel train step whose gradient
+allreduce crosses the process boundary (the DCN-analogue on this CPU
+harness). Prints one JSON line the parent asserts on.
+
+Usage: python multihost_worker.py <coordinator> <num_processes> <process_id>
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    # Same virtual-CPU-backend forcing as tests/conftest.py (the axon
+    # sitecustomize re-registers the TPU backend at interpreter start).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.initialize_distributed(coordinator, nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.default_backend() == "cpu", jax.default_backend()
+
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from robotic_discovery_platform_tpu.models import losses as losses_lib
+    from robotic_discovery_platform_tpu.models.unet import build_unet
+    from robotic_discovery_platform_tpu.parallel import dp
+    from robotic_discovery_platform_tpu.training import trainer
+    from robotic_discovery_platform_tpu.utils.config import MeshConfig, ModelConfig
+
+    n_global = jax.device_count()
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n_global, spatial=1, model=1))
+
+    model = build_unet(ModelConfig(base_features=8, compute_dtype="float32"))
+    tx = optax.adam(1e-3)
+    loss_fn = losses_lib.make_loss_fn("bce", 0.5)
+    state = trainer.create_state(model, tx, jax.random.key(0), 32)
+    train_step, eval_step, state = dp.parallelize_training(
+        mesh, model, tx, loss_fn, state, donate=False
+    )
+
+    # Deterministic global batch; every process materializes the full array
+    # and hands its local rows to the runtime.
+    rng = np.random.default_rng(0)
+    gx = rng.random((2 * n_global, 32, 32, 3)).astype(np.float32)
+    gy = (rng.random((2 * n_global, 32, 32, 1)) > 0.5).astype(np.float32)
+    batch_sh = NamedSharding(mesh, P("data"))
+    x = jax.make_array_from_process_local_data(batch_sh, gx[pid * 4:(pid + 1) * 4])
+    y = jax.make_array_from_process_local_data(batch_sh, gy[pid * 4:(pid + 1) * 4])
+
+    state, loss = train_step(state, x, y)
+    metrics = eval_step(state, x, y)
+
+    print(json.dumps({
+        "pid": pid,
+        "processes": jax.process_count(),
+        "global_devices": n_global,
+        "local_devices": len(jax.local_devices()),
+        "loss": float(loss),
+        "val_loss": float(metrics["loss"]),
+        "miou": float(metrics["miou"]),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

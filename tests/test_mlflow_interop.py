@@ -1,0 +1,74 @@
+"""Real-MLflow backend round-trip (VERDICT round-1 item 7).
+
+Skipped when mlflow is not installed (it is an optional extra; the default
+FileStore backend is dependency-free). With mlflow present, this proves the
+whole tracking contract -- params, metrics, model logging, registry
+versions, the staging alias, and ``load_model`` -- runs unchanged over a
+genuine MLflow file store (the reference's actual setup,
+scripts/train_segmenter.py:112-129,195-207), so the serving path can load
+from a real MLflow registry.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+mlflow = pytest.importorskip("mlflow")
+
+from robotic_discovery_platform_tpu import tracking  # noqa: E402
+from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet  # noqa: E402
+from robotic_discovery_platform_tpu.utils.config import ModelConfig  # noqa: E402
+
+
+@pytest.fixture()
+def mlflow_uri(tmp_path):
+    uri = f"mlflow+file:{tmp_path}/mlruns"
+    tracking.set_tracking_uri(uri)
+    yield uri
+    tracking.set_tracking_uri("file:ml/mlruns")
+
+
+def test_mlflow_round_trip(mlflow_uri):
+    import jax
+
+    tracking.set_experiment("Actuator Segmentation")
+    cfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(cfg)
+    variables = init_unet(model, jax.random.key(0), 32)
+
+    with tracking.start_run() as run:
+        tracking.log_params({"learning_rate": 1e-4, "batch_size": 4})
+        tracking.log_metric("train_loss", 0.7, step=0)
+        tracking.log_metric("train_loss", 0.5, step=1)
+        version = tracking.log_model(
+            variables, cfg, registered_model_name="Actuator-Segmenter"
+        )
+    assert version == 1
+
+    hist = tracking.get_metric_history(run.info.run_id, "train_loss")
+    assert [h["step"] for h in hist] == [0, 1]
+    assert [h["value"] for h in hist] == [0.7, 0.5]
+
+    client = tracking.Client()
+    client.set_registered_model_alias("Actuator-Segmenter", "staging", version)
+    assert client.get_model_version_by_alias(
+        "Actuator-Segmenter", "staging"
+    ).version == 1
+
+    for uri in ("models:/Actuator-Segmenter/latest",
+                "models:/Actuator-Segmenter@staging"):
+        loaded_model, loaded_vars = tracking.load_model(uri)
+        y = loaded_model.apply(loaded_vars, jnp.zeros((1, 32, 32, 3)),
+                               train=False)
+        assert y.shape == (1, 32, 32, 1)
+        np.testing.assert_allclose(
+            np.asarray(y),
+            np.asarray(model.apply(variables, jnp.zeros((1, 32, 32, 3)),
+                                   train=False)),
+        )
+
+    # params/metrics visible to a raw mlflow client (mlflow ui would browse
+    # this same store)
+    raw = mlflow.tracking.MlflowClient(tracking_uri=mlflow_uri[len("mlflow+"):])
+    data = raw.get_run(run.info.run_id).data
+    assert data.params["batch_size"] == "4"

@@ -1,0 +1,67 @@
+"""Multi-host bring-up test (SURVEY.md section 5.8; VERDICT round-1 item 10).
+
+Launches two fresh Python processes that form a real 2-process JAX cluster
+over ``jax.distributed.initialize`` (coordinator on localhost), build one
+global 4-device mesh (2 virtual CPU devices per process), and run a
+data-parallel train step whose gradient allreduce crosses the process
+boundary. This is the CPU-harness stand-in for multi-host TPU pods over
+ICI/DCN -- the same ``parallel`` code paths run unchanged there.
+
+Runs in subprocesses because ``jax.distributed`` can only be initialized
+once per process (and the test session's jax is already single-process).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+WORKER = Path(__file__).parent / "multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_train_step():
+    coordinator = f"localhost:{_free_port()}"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host worker timed out")
+        assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    by_pid = {o["pid"]: o for o in outs}
+    assert set(by_pid) == {0, 1}
+    for o in outs:
+        assert o["processes"] == 2
+        assert o["global_devices"] == 4
+        assert o["local_devices"] == 2
+        assert np.isfinite(o["loss"])
+    # the allreduce makes the replicated loss/metrics identical across hosts
+    assert by_pid[0]["loss"] == pytest.approx(by_pid[1]["loss"], rel=1e-6)
+    assert by_pid[0]["val_loss"] == pytest.approx(by_pid[1]["val_loss"], rel=1e-6)

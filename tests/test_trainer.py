@@ -95,3 +95,52 @@ def test_file_dataset_roundtrip(tmp_path):
     assert set(np.unique(ys)) <= {0.0, 1.0}
     # masks are non-trivial
     assert ys.mean() > 0.01
+
+
+def test_streaming_batches_match_in_memory(tmp_path):
+    from robotic_discovery_platform_tpu.training.data import (
+        Batches, PairedSegmentationData, StreamingBatches)
+
+    synthetic.generate_dataset(tmp_path / "ds", n=6, h=64, w=64)
+    ds = PairedSegmentationData(tmp_path / "ds", img_size=32)
+    xs, ys = ds.as_arrays()
+    idx = np.arange(len(ds))
+    streamed = list(StreamingBatches(ds, idx, 4, shuffle=False, workers=2))
+    in_mem = list(Batches(xs, ys, 4, shuffle=False))
+    assert len(streamed) == len(in_mem) == 2
+    for (sx, sy), (mx, my) in zip(streamed, in_mem):
+        np.testing.assert_array_equal(sx, mx)
+        np.testing.assert_array_equal(sy, my)
+
+
+def test_streaming_batches_tiny_subset_pads(tmp_path):
+    from robotic_discovery_platform_tpu.training.data import (
+        PairedSegmentationData, StreamingBatches)
+
+    synthetic.generate_dataset(tmp_path / "ds", n=3, h=64, w=64)
+    ds = PairedSegmentationData(tmp_path / "ds", img_size=32)
+    # a 1-sample subset with batch 4 must wrap-pad, not crash
+    batches = list(StreamingBatches(ds, [0], 4, shuffle=False))
+    assert len(batches) == 1
+    bx, by = batches[0]
+    assert bx.shape == (4, 32, 32, 3) and by.shape == (4, 32, 32, 1)
+    np.testing.assert_array_equal(bx[0], bx[1])
+
+
+def test_streaming_batches_surface_decode_errors(tmp_path):
+    from robotic_discovery_platform_tpu.training.data import (
+        PairedSegmentationData, StreamingBatches)
+
+    synthetic.generate_dataset(tmp_path / "ds", n=2, h=64, w=64)
+    ds = PairedSegmentationData(tmp_path / "ds", img_size=32)
+    (tmp_path / "ds" / "images" / ds.names[0]).write_bytes(b"not an image")
+    with pytest.raises(IOError):
+        list(StreamingBatches(ds, [0, 1], 2, shuffle=False))
+
+
+def test_train_model_streams_from_disk(tmp_path):
+    synthetic.generate_dataset(tmp_path / "ds", n=8, h=64, w=64)
+    cfg = tiny_cfg(tmp_path, epochs=1, dataset_dir=str(tmp_path / "ds"))
+    res = trainer.train_model(cfg, TINY_MODEL, register=False)
+    assert np.isfinite(res.best_val_loss)
+    assert "miou" in res.final_metrics
