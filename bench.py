@@ -102,16 +102,25 @@ def main() -> None:
         intr_b = jnp.broadcast_to(intrinsics, (batch, 3, 3))
         scale_b = jnp.broadcast_to(scale, (batch,))
 
+        def per_frame(mm, dd, kk, ss):
+            return geometry.compute_curvature_profile(mm, dd, kk, ss, geom_cfg)
+
         def fused_step(f):  # f: [B, H, W, 3] uint8
             x = pipeline.preprocess(f, 256)
             logits = (forward(x) if forward is not None
                       else model.apply(variables, x, train=False))
             m = pipeline.logits_to_native_masks(logits, h, w)
-            prof = jax.vmap(
-                lambda mm, dd, kk, ss: geometry.compute_curvature_profile(
-                    mm, dd, kk, ss, geom_cfg
+            # same batching policy as ops/pipeline._analyze_batch: geometry
+            # unbatched per frame (vmap costs 7x on its top_k selection)
+            if batch == 1:
+                prof = jax.tree.map(
+                    lambda a: a[None],
+                    per_frame(m[0], depth_b[0], intr_b[0], scale_b[0]),
                 )
-            )(m, depth_b, intr_b, scale_b)
+            else:
+                prof = jax.lax.map(
+                    lambda args: per_frame(*args), (m, depth_b, intr_b, scale_b)
+                )
             # Data dependency on BOTH the mask and the curvature result so no
             # stage can be dead-code-eliminated across iterations.
             dep = (m & jnp.uint8(1)) ^ (

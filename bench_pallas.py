@@ -1,0 +1,223 @@
+"""Pallas-vs-XLA micro-benchmark at the deployed U-Net's layer shapes.
+
+The claim behind ops/pallas (SURVEY.md Phase 2: kernels for the reference's
+hot blocks, pkg/segmentation_model.py:24-40,54-65) is checked empirically
+here: for every 3x3 conv+BN+ReLU shape in the 256x256 inference forward,
+plus the 1x1 head and the 2x2 stride-2 transpose conv, time the fused
+Pallas kernel against the plain-XLA equivalent on the real chip, then time
+the whole-net forward (auto-dispatched Pallas net vs Flax/XLA). Writes
+PALLASBENCH.json -- the in-repo evidence for the per-shape dispatch
+threshold in ops/pallas/unet_infer.py (PALLAS_MAX_ELEMS).
+
+Same chained-scan timing as bench.py (see its docstring): K data-dependent
+kernel applications inside one compiled ``lax.scan``, one host fetch, minus
+the independently measured fetch round-trip. bf16 inputs / f32 accumulation,
+matching serving.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+CHAIN = 100
+
+# Every distinct (H, W, Cin, Cout) the deployed bilinear-variant forward
+# runs through conv3x3_bn_relu at batch 1, 256x256 input
+# (models/unet.py channel ladder 64..512, halved decoder mids).
+CONV3X3_SHAPES = [
+    (256, 256, 3, 64), (256, 256, 64, 64),
+    (128, 128, 64, 128), (128, 128, 128, 128),
+    (64, 64, 128, 256), (64, 64, 256, 256),
+    (32, 32, 256, 512), (32, 32, 512, 512),
+    (16, 16, 512, 512),
+    (32, 32, 1024, 512), (32, 32, 512, 256),
+    (64, 64, 512, 256), (64, 64, 256, 128),
+    (128, 128, 256, 128), (128, 128, 128, 64),
+    (256, 256, 128, 64),
+]
+
+
+def _roundtrip_ms() -> float:
+    @jax.jit
+    def trivial(x):
+        return x + 1.0
+
+    x = jnp.ones((8,))
+    float(trivial(x)[0])
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        float(trivial(x)[0])
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1e3)
+
+
+def _time_chain(fn, x0, rt_ms: float, reps: int = 3) -> float:
+    """Per-application ms of ``fn`` chained CHAIN times (x must map to an
+    output that can be fed back; callers wrap to keep shapes fixed)."""
+
+    @jax.jit
+    def chained(x):
+        final, _ = lax.scan(lambda c, _: (fn(c), None), x, None, length=CHAIN)
+        return final
+
+    np.asarray(jax.block_until_ready(chained(x0)))  # compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(chained(x0))
+        best = min(best, time.perf_counter() - t0)
+    return max((best * 1e3 - rt_ms) / CHAIN, 1e-6)
+
+
+def bench_conv3x3(rt_ms: float) -> list[dict]:
+    from robotic_discovery_platform_tpu.ops.pallas import (
+        conv3x3_bn_relu, conv3x3_bn_relu_xla)
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for h, w, ci, co in CONV3X3_SHAPES:
+        x = jnp.asarray(rng.normal(size=(1, h, w, ci)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(3, 3, ci, co)) * 0.1, jnp.float32)
+        scale = jnp.ones((co,), jnp.float32)
+        bias = jnp.zeros((co,), jnp.float32)
+        # feed a Cin-slice of the output back in so the chain is
+        # data-dependent but shape-stable
+        reps_in = -(-ci // co)  # ceil
+
+        def step(c, kernel=k, s=scale, b=bias, cin=ci, r=reps_in):
+            y = conv3x3_bn_relu(c, kernel, s, b, relu=True)
+            return jnp.tile(y, (1, 1, 1, r))[..., :cin].astype(jnp.bfloat16)
+
+        def step_xla(c, kernel=k, s=scale, b=bias, cin=ci, r=reps_in):
+            y = conv3x3_bn_relu_xla(c, kernel, s, b, relu=True)
+            return jnp.tile(y, (1, 1, 1, r))[..., :cin].astype(jnp.bfloat16)
+
+        t_pallas = _time_chain(step, x, rt_ms)
+        t_xla = _time_chain(step_xla, x, rt_ms)
+        rows.append({
+            "op": "conv3x3_bn_relu", "h": h, "w": w, "cin": ci, "cout": co,
+            "pallas_ms": round(t_pallas, 4), "xla_ms": round(t_xla, 4),
+            "speedup": round(t_xla / t_pallas, 3),
+        })
+        print(f"# 3x3 {h}x{w} {ci}->{co}: pallas={t_pallas:.3f}ms "
+              f"xla={t_xla:.3f}ms x{t_xla / t_pallas:.2f}", file=sys.stderr)
+    return rows
+
+
+def bench_heads(rt_ms: float) -> list[dict]:
+    from robotic_discovery_platform_tpu.ops.pallas import (
+        conv1x1, conv1x1_xla, conv_transpose2x2, conv_transpose2x2_xla)
+
+    rng = np.random.default_rng(1)
+    rows = []
+
+    # 1x1 head at full resolution: 256x256, 64 -> 1 (OutConv). conv1x1
+    # takes the [Cin, Cout] kernel (the [0, 0] slice of the HWIO tree, same
+    # as unet_infer's call site).
+    x = jnp.asarray(rng.normal(size=(1, 256, 256, 64)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(64, 1)) * 0.1, jnp.float32)
+    s, b = jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.float32)
+
+    def head(c):
+        y = conv1x1(c, k, s, b)
+        return (c + y.astype(jnp.bfloat16))  # broadcast dependency
+
+    def head_xla(c):
+        y = conv1x1_xla(c, k, s, b)
+        return (c + y.astype(jnp.bfloat16))
+
+    t_p, t_x = _time_chain(head, x, rt_ms), _time_chain(head_xla, x, rt_ms)
+    rows.append({"op": "conv1x1", "h": 256, "w": 256, "cin": 64, "cout": 1,
+                 "pallas_ms": round(t_p, 4), "xla_ms": round(t_x, 4),
+                 "speedup": round(t_x / t_p, 3)})
+    print(f"# 1x1 head: pallas={t_p:.3f}ms xla={t_x:.3f}ms", file=sys.stderr)
+
+    # transpose-conv decoder step (non-bilinear variant): 32x32 512 -> 256
+    x = jnp.asarray(rng.normal(size=(1, 32, 32, 512)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(2, 2, 512, 256)) * 0.1, jnp.float32)
+    bias = jnp.zeros((256,), jnp.float32)
+
+    def tc(c):
+        y = conv_transpose2x2(c, k, bias)  # [1,64,64,256]
+        y = y.reshape(1, 32, 2, 32, 2, 256).mean((2, 4))  # back to 32x32
+        return jnp.tile(y, (1, 1, 1, 2)).astype(jnp.bfloat16)
+
+    def tc_xla(c):
+        y = conv_transpose2x2_xla(c, k, bias)
+        y = y.reshape(1, 32, 2, 32, 2, 256).mean((2, 4))
+        return jnp.tile(y, (1, 1, 1, 2)).astype(jnp.bfloat16)
+
+    t_p, t_x = _time_chain(tc, x, rt_ms), _time_chain(tc_xla, x, rt_ms)
+    rows.append({"op": "conv_transpose2x2", "h": 32, "w": 32, "cin": 512,
+                 "cout": 256, "pallas_ms": round(t_p, 4),
+                 "xla_ms": round(t_x, 4), "speedup": round(t_x / t_p, 3)})
+    print(f"# 2x2^T: pallas={t_p:.3f}ms xla={t_x:.3f}ms", file=sys.stderr)
+    return rows
+
+
+def bench_full_forward(rt_ms: float) -> dict:
+    from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
+    from robotic_discovery_platform_tpu.ops.pallas import make_pallas_unet
+    from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+    model = build_unet(ModelConfig())
+    variables = init_unet(model, jax.random.key(0))
+    pnet = make_pallas_unet(model, variables)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.uniform(size=(1, 256, 256, 3)), jnp.bfloat16)
+
+    def flax_fwd(c):
+        y = model.apply(variables, c, train=False)  # [1,256,256,1]
+        return jnp.concatenate([c[..., :2], y.astype(jnp.bfloat16)], -1)
+
+    def pallas_fwd(c):
+        y = pnet(c)
+        return jnp.concatenate([c[..., :2], y.astype(jnp.bfloat16)], -1)
+
+    t_flax = _time_chain(flax_fwd, x, rt_ms)
+    t_pallas = _time_chain(pallas_fwd, x, rt_ms)
+    print(f"# full forward 256x256: pallas-auto={t_pallas:.3f}ms "
+          f"flax/xla={t_flax:.3f}ms", file=sys.stderr)
+    return {"flax_xla_ms": round(t_flax, 4),
+            "pallas_auto_ms": round(t_pallas, 4),
+            "speedup": round(t_flax / t_pallas, 3)}
+
+
+def main() -> None:
+    if jax.default_backend() != "tpu":
+        print("PALLASBENCH needs the TPU backend (kernels interpret-only "
+              "on CPU)", file=sys.stderr)
+        sys.exit(1)
+    rt_ms = _roundtrip_ms()
+    result = {
+        "backend": jax.default_backend(),
+        "device": jax.devices()[0].device_kind,
+        "chain": CHAIN,
+        "roundtrip_ms": round(rt_ms, 1),
+        "dtype": "bfloat16 in / f32 accumulate",
+        "conv3x3": bench_conv3x3(rt_ms),
+        "heads": bench_heads(rt_ms),
+        "full_forward_b1_256": bench_full_forward(rt_ms),
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    out = REPO / "PALLASBENCH.json"
+    out.write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps({"wrote": str(out),
+                      "full_forward": result["full_forward_b1_256"]}))
+
+
+if __name__ == "__main__":
+    main()

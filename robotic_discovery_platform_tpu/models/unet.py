@@ -39,7 +39,13 @@ DType = Any
 
 def _norm(norm: str, dtype: DType, train: bool, features: int):
     if norm == "batch":
-        return nn.BatchNorm(use_running_average=not train, dtype=dtype)
+        # momentum 0.9 matches the reference's torch BatchNorm2d default
+        # (momentum=0.1 on the *new* batch, i.e. 0.9 decay on the running
+        # value; pkg/segmentation_model.py:35). Flax's own default of 0.99
+        # leaves running stats ~30% initialization after a 120-step run,
+        # which wrecks eval-mode predictions on short trainings.
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                            dtype=dtype)
     if norm == "group":
         import math
 

@@ -226,6 +226,22 @@ def _conv1x1_kernel(x_ref, w_ref, sb_ref, o_ref, *, relu):
     o_ref[0] = y.reshape(th, width, tile_co).astype(o_ref.dtype)
 
 
+def _conv1x1_squeeze_kernel(x_ref, w_ref, sb_ref, o_ref, *, relu):
+    """cout == 1 head: the output block is [1, tile_h, W] so the *width*
+    rides on the VMEM lane dimension. Writing a [..., 1] block instead would
+    pad that final dim 1 -> 128 lanes and blow the scoped-VMEM budget 128x
+    (observed as a 24 MB stack allocation at batch 8, 256x256)."""
+    th, width, cin = x_ref.shape[1:]
+    y = jnp.dot(
+        x_ref[0].reshape(th * width, cin), w_ref[:],
+        preferred_element_type=jnp.float32,
+    )
+    y = y * sb_ref[0, 0] + sb_ref[1, 0]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    o_ref[0] = y.reshape(th, width).astype(o_ref.dtype)
+
+
 @functools.partial(
     jax.jit, static_argnames=("relu", "out_dtype", "interpret")
 )
@@ -238,23 +254,53 @@ def conv1x1(x, w, scale, bias, *, relu: bool = False, out_dtype=None,
     cout = w.shape[-1]
     out_dtype = x.dtype if out_dtype is None else out_dtype
     tile_co = _pick_tile(cout, 256)
+    squeeze = cout == 1
+    # VMEM budget per block, counting the lane padding the (8,128) tiled
+    # layout applies to each buffer's final dimension.
     budget = 5 * 1024 * 1024
+
+    def _padded(n: int) -> int:
+        return -(-n // 128) * 128
+
+    out_lanes = width if squeeze else _padded(tile_co)
+    out_lane_rows = 1 if squeeze else width
     tile_h = _pick_tile(h, 128)
-    while tile_h > 1 and 2 * tile_h * width * (
-        cin * x.dtype.itemsize + tile_co * jnp.dtype(out_dtype).itemsize
+    while tile_h > 1 and 2 * tile_h * (
+        width * _padded(cin) * x.dtype.itemsize
+        + out_lane_rows * out_lanes * jnp.dtype(out_dtype).itemsize
     ) + tile_h * width * tile_co * 4 > budget:
         tile_h = _pick_tile(h, tile_h // 2)
     w = w.astype(x.dtype)
     sb = jnp.stack([scale, bias]).astype(jnp.float32)
+
+    x_spec = pl.BlockSpec(
+        (1, tile_h, width, cin), lambda bi, t, co: (bi, t, 0, 0)
+    )
+    if squeeze:
+        out = pl.pallas_call(
+            functools.partial(_conv1x1_squeeze_kernel, relu=relu),
+            grid=(b, h // tile_h),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, tile_h, width, cin), lambda bi, t: (bi, t, 0, 0)
+                ),
+                pl.BlockSpec((cin, 1), lambda bi, t: (0, 0)),
+                pl.BlockSpec((2, 1), lambda bi, t: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, tile_h, width), lambda bi, t: (bi, t, 0)
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, h, width), out_dtype),
+            interpret=interpret,
+        )(x, w, sb)
+        return out[..., None]
 
     kern = functools.partial(_conv1x1_kernel, relu=relu)
     return pl.pallas_call(
         kern,
         grid=(b, h // tile_h, cout // tile_co),
         in_specs=[
-            pl.BlockSpec(
-                (1, tile_h, width, cin), lambda bi, t, co: (bi, t, 0, 0)
-            ),
+            x_spec,
             pl.BlockSpec((cin, tile_co), lambda bi, t, co: (0, co)),
             pl.BlockSpec((2, tile_co), lambda bi, t, co: (0, co)),
         ],

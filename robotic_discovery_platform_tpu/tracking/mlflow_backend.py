@@ -69,11 +69,16 @@ class MlflowStore:
         self.client.set_terminated(run_id, status=status)
 
     def get_run(self, run_id: str) -> dict:
+        # same key shape as FileStore.create_run meta (store.py:90-97)
         run = self.client.get_run(run_id)
         return {
             "run_id": run_id,
+            "run_name": run.info.run_name,
             "experiment_id": run.info.experiment_id,
             "status": run.info.status,
+            "start_time": (run.info.start_time or 0) / 1e3,
+            "end_time": (run.info.end_time / 1e3
+                         if run.info.end_time else None),
         }
 
     # -- params / metrics ---------------------------------------------------
@@ -91,8 +96,9 @@ class MlflowStore:
                                step=0 if step is None else int(step))
 
     def get_metric_history(self, run_id: str, key: str) -> list[dict]:
+        # "ts" in seconds, matching FileStore.log_metric (store.py:130)
         return [
-            {"step": m.step, "value": m.value, "timestamp": m.timestamp}
+            {"step": m.step, "value": m.value, "ts": m.timestamp / 1e3}
             for m in self.client.get_metric_history(run_id, key)
         ]
 

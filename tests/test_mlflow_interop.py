@@ -22,10 +22,17 @@ from robotic_discovery_platform_tpu.utils.config import ModelConfig  # noqa: E40
 
 @pytest.fixture()
 def mlflow_uri(tmp_path):
+    from robotic_discovery_platform_tpu.tracking import api
+
+    prev_uri = tracking.get_tracking_uri()
+    prev_exp = api._state.experiment_id
     uri = f"mlflow+file:{tmp_path}/mlruns"
     tracking.set_tracking_uri(uri)
     yield uri
-    tracking.set_tracking_uri("file:ml/mlruns")
+    # restore the prior URI AND experiment id so later tests don't create
+    # runs under this store's experiment in the default file store
+    tracking.set_tracking_uri(prev_uri)
+    api._state.experiment_id = prev_exp
 
 
 def test_mlflow_round_trip(mlflow_uri):

@@ -44,16 +44,28 @@ def test_two_process_train_step():
         )
         for pid in range(2)
     ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-host worker timed out")
-        assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+    # collect BOTH workers before asserting anything: an early assert for
+    # worker 0 would leak worker 1 blocked in distributed init for minutes
+    results = []
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+                err += "\n[killed: timeout]"
+            results.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    failures = [
+        f"worker {i} rc={rc}:\n{err[-4000:]}"
+        for i, (rc, _, err) in enumerate(results) if rc != 0
+    ]
+    assert not failures, "\n---\n".join(failures)
+    outs = [json.loads(out.strip().splitlines()[-1]) for _, out, _ in results]
 
     by_pid = {o["pid"]: o for o in outs}
     assert set(by_pid) == {0, 1}

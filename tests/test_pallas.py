@@ -82,6 +82,22 @@ def test_conv1x1_matches_xla():
     )
 
 
+@pytest.mark.parametrize("relu", [True, False])
+def test_conv1x1_single_channel_head(relu):
+    """cout=1 takes the squeezed-output kernel (lane dim = width); a
+    [..., 1] output block would pad 1 -> 128 lanes and OOM scoped VMEM at
+    batch 8 on TPU (seen in bench.py batched serving)."""
+    x = _rand(8, 16, 24, 8)
+    k = _rand(8, 1)
+    s, bias = jnp.full((1,), 1.3), _rand(1)
+    want = conv1x1_xla(x, k, s, bias, relu=relu)
+    got = conv1x1(x, k, s, bias, relu=relu, interpret=True)
+    assert got.shape == want.shape == (8, 16, 24, 1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
 def test_conv_transpose_matches_flax():
     """The 4-matmul interleave equals nn.ConvTranspose((2,2), stride 2)."""
     x = _rand(2, 8, 8, 16)
