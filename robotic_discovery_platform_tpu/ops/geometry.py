@@ -10,10 +10,11 @@ the U-Net forward pass) inside a single jitted XLA graph:
 
 - dense deprojection over the full H x W grid instead of a gather
   (reference :101-117);
-- a fixed ``max_points`` gather budget via ``top_k`` ordered by image row, so
-  truncation (if ever hit) drops the points *farthest* from the top edge;
-- per-bin ``top_k`` with a dynamic cutoff ``k_b`` applied as a mask over a
-  static ``max_per_bin`` budget (reference :134-140);
+- edge extraction as ONE lexicographic sort of the dense maps by
+  (x-bin, -y): each bin becomes a contiguous descending-y segment, so the
+  reference's per-bin "top 5% by y" (:134-140) is the head of each segment
+  -- masked by a dynamic cutoff ``k_b`` over a static ``max_per_bin``
+  budget. No intermediate compaction, no data-dependent shapes;
 - a fixed-knot penalized least-squares B-spline instead of ``splprep``
   (see ops/bspline.py; reference :78);
 - graceful-zero semantics via flags instead of early returns: <100 cloud
@@ -47,7 +48,7 @@ class CurvatureProfile(NamedTuple):
     valid: jnp.ndarray  # scalar bool
     num_cloud_points: jnp.ndarray  # scalar int (diagnostics)
     num_edge_points: jnp.ndarray  # scalar int (diagnostics)
-    truncated: jnp.ndarray  # scalar bool: max_points or per-bin budget exceeded
+    truncated: jnp.ndarray  # scalar bool: per-bin max_per_bin budget bound
 
 
 def deproject(mask, depth, fx, fy, cx, cy, depth_scale):
@@ -66,40 +67,30 @@ def deproject(mask, depth, fx, fy, cx, cy, depth_scale):
     return x, y, z, valid
 
 
-def _gather_cloud(x, y, z, valid, max_points: int):
-    """Flatten the dense maps into a fixed-size [P, 3] cloud + weights.
-
-    Selection key is the image row (v) so that when the valid count exceeds
-    ``max_points`` we keep the *bottom-most* rows -- the candidates for the
-    top edge in camera coordinates (largest y, reference :139 takes the
-    largest-y points per bin).
-    """
-    h, w = x.shape
-    max_points = min(max_points, h * w)  # top_k requires k <= size
-    vrow = jax.lax.broadcasted_iota(jnp.float32, (h, w), 0)
-    score = jnp.where(valid, vrow, -1.0).reshape(-1)
-    _, idx = jax.lax.top_k(score, max_points)
-    pts = jnp.stack(
-        [x.reshape(-1)[idx], y.reshape(-1)[idx], z.reshape(-1)[idx]], axis=-1
-    )
-    w_sel = (score[idx] >= 0.0).astype(jnp.float32)
-    return pts, w_sel
-
-
-def _edge_points(pts, w_sel, cfg: GeometryConfig):
+def _edge_points(x, y, z, valid, cfg: GeometryConfig):
     """Static-shape re-expression of ``_find_point_cloud_edge``
-    (reference :119-142): bin x into ``num_bins`` equal bins over the valid
-    x-range, keep the top ``max(1, floor(0.05 * n_b))`` points by y per bin.
+    (reference :119-142), operating directly on the dense deprojection
+    maps: bin x into ``num_bins`` equal bins over the valid x-range, keep
+    the top ``max(1, floor(0.05 * n_b))`` points by y per bin.
+
+    One lexicographic sort of the flattened maps by (bin, -y) replaces both
+    the fixed-budget cloud compaction and per-bin top_k passes of earlier
+    designs (the hot spot: 50 batched top_k(128) over a 65536-point cloud
+    cost ~2.3 ms/frame on v5e, plus ~1 ms for the compaction's own top_k;
+    the single sort does the whole job in under 2 ms with no cloud-size
+    budget at all). After the sort each bin is a contiguous descending-y
+    segment, so "top k_b by y" is the head of each segment.
 
     Returns ([num_bins * max_per_bin, 3] points, matching weights,
-    edge_count, binnable flag).
+    edge_count, binnable flag, per-bin-cap flag).
     """
-    xs = pts[:, 0]
-    ys = pts[:, 1]
+    xs = x.reshape(-1)
+    ys = y.reshape(-1)
+    v = valid.reshape(-1)
     big = jnp.float32(1e30)
-    x_min = jnp.min(jnp.where(w_sel > 0, xs, big))
-    x_max = jnp.max(jnp.where(w_sel > 0, xs, -big))
-    n_valid = jnp.sum(w_sel)
+    x_min = jnp.min(jnp.where(v, xs, big))
+    x_max = jnp.max(jnp.where(v, xs, -big))
+    n_valid = jnp.sum(v)
     bin_width = (x_max - x_min) / cfg.num_bins
     binnable = (n_valid >= cfg.num_bins) & (bin_width > 0)
 
@@ -108,34 +99,39 @@ def _edge_points(pts, w_sel, cfg: GeometryConfig):
         jnp.floor((xs - x_min) / safe_width).astype(jnp.int32), 0, cfg.num_bins - 1
     )
 
-    def per_bin(b):
-        in_bin = (bin_idx == b) & (w_sel > 0)
-        n_b = jnp.sum(in_bin)
-        # k_b = max(1, floor(n_b * top_k_percent)), 0 when the bin is empty
-        # (reference :138).
-        k_b = jnp.where(
-            n_b > 0,
-            jnp.maximum(1, jnp.floor(n_b * cfg.top_k_percent).astype(jnp.int32)),
-            0,
-        )
-        yk = jnp.where(in_bin, ys, -big)
-        vals, idxs = jax.lax.top_k(yk, cfg.max_per_bin)
-        rank = jnp.arange(cfg.max_per_bin)
-        keep = (rank < k_b) & (vals > -big)
-        # k_b is capped at the static max_per_bin budget; report when the cap
-        # binds so frames using fewer edge points than the reference's 5%
-        # rule are flagged rather than silent.
-        return pts[idxs], keep.astype(jnp.float32), k_b > cfg.max_per_bin
-
-    bins = jnp.arange(cfg.num_bins)
-    e_pts, e_w, capped = jax.vmap(per_bin)(bins)  # [B, K, 3], [B, K], [B]
-    e_pts = e_pts.reshape(-1, 3)
-    e_w = e_w.reshape(-1) * binnable.astype(jnp.float32)
+    p = xs.shape[0]
+    key_bin = jnp.where(v, bin_idx, cfg.num_bins)  # invalid sorts last
+    key_negy = jnp.where(v, -ys, big)
+    sorted_bin, _, sorted_idx = jax.lax.sort(
+        (key_bin, key_negy, jnp.arange(p, dtype=jnp.int32)), num_keys=2
+    )
+    bins = jnp.arange(cfg.num_bins, dtype=jnp.int32)
+    starts = jnp.searchsorted(sorted_bin, bins)
+    ends = jnp.searchsorted(sorted_bin, bins, side="right")
+    n_b = (ends - starts).astype(jnp.int32)
+    # k_b = max(1, floor(n_b * top_k_percent)), 0 when the bin is empty
+    # (reference :138).
+    k_b = jnp.where(
+        n_b > 0,
+        jnp.maximum(1, jnp.floor(n_b * cfg.top_k_percent).astype(jnp.int32)),
+        0,
+    )
+    rank = jnp.arange(cfg.max_per_bin)
+    gather = jnp.clip(starts[:, None] + rank[None, :], 0, p - 1)  # [B, K]
+    sel = sorted_idx[gather].reshape(-1)
+    e_pts = jnp.stack([xs[sel], ys[sel], z.reshape(-1)[sel]], axis=-1)
+    # k_b is capped at the static max_per_bin budget; report when the cap
+    # binds so frames using fewer edge points than the reference's 5%
+    # rule are flagged rather than silent.
+    keep = (rank[None, :] < jnp.minimum(k_b, cfg.max_per_bin)[:, None]) & (
+        rank[None, :] < n_b[:, None]
+    )
+    e_w = keep.reshape(-1).astype(jnp.float32) * binnable.astype(jnp.float32)
     # Mask the cap flag by binnable: a frame with a degenerate x-range dumps
     # everything into bin 0 and is already invalid, not "truncated".
     return (
         e_pts, e_w, jnp.sum(e_w).astype(jnp.int32), binnable,
-        jnp.any(capped) & binnable,
+        jnp.any((k_b > cfg.max_per_bin) & (n_b > 0)) & binnable,
     )
 
 
@@ -172,10 +168,11 @@ def compute_curvature_profile(
     cx, cy = intrinsics[0, 2], intrinsics[1, 2]
 
     x, y, z, valid_map = deproject(mask, depth, fx, fy, cx, cy, depth_scale)
-    pts, w_sel = _gather_cloud(x, y, z, valid_map, cfg.max_points)
     cloud_count = jnp.sum(valid_map).astype(jnp.int32)
 
-    e_pts, e_w, edge_count, binnable, bin_capped = _edge_points(pts, w_sel, cfg)
+    e_pts, e_w, edge_count, binnable, bin_capped = _edge_points(
+        x, y, z, valid_map, cfg
+    )
     s_pts, s_w = _sort_by_x(e_pts, e_w)
 
     knots = bspline.clamped_uniform_knots(cfg.num_ctrl, cfg.spline_degree)
@@ -198,7 +195,6 @@ def compute_curvature_profile(
         & (n_kv > 0)
     )
     zero = jnp.float32(0.0)
-    budget = min(cfg.max_points, mask.shape[0] * mask.shape[1])
     return CurvatureProfile(
         mean_curvature=jnp.where(ok, mean_k, zero),
         max_curvature=jnp.where(ok, max_k, zero),
@@ -206,7 +202,7 @@ def compute_curvature_profile(
         valid=ok,
         num_cloud_points=cloud_count,
         num_edge_points=edge_count,
-        truncated=(cloud_count > budget) | bin_capped,
+        truncated=bin_capped,
     )
 
 

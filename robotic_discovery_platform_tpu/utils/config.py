@@ -74,6 +74,16 @@ class TrainConfig:
     log_every: int = 1
     # decode threads for the streaming file loader (StreamingBatches)
     loader_workers: int = 4
+    # Epoch execution: "auto" runs whole epochs in one lax.scan dispatch
+    # when the dataset is in-memory, fits scan_max_bytes, and no mesh is
+    # given (one host fetch per epoch instead of per step); "stream"
+    # forces the per-batch loop; "scan" requires the scan path and errors
+    # if unavailable.
+    epoch_mode: str = "auto"
+    # device-residency cap for "auto" scan mode; datasets above this fall
+    # back to the streamed per-batch path (v5e has 16 GiB HBM; leave room
+    # for params, activations, and the donated state copy)
+    scan_max_bytes: int = 4 * 1024**3
 
 
 @dataclass(frozen=True)
@@ -86,8 +96,8 @@ class GeometryConfig:
     - graceful-zero cutoffs: <100 cloud points (:64), <20 edge points (:69).
 
     TPU additions (static-shape budget; no reference equivalent):
-    - ``max_points``: fixed-size point-cloud gather budget.
-    - ``max_per_bin``: fixed top-k budget per bin.
+    - ``max_per_bin``: fixed top-k budget per bin (edge extraction works
+      on the dense maps directly -- no cloud-size budget).
     - ``num_ctrl``: number of cubic B-spline basis functions for the
       fixed-knot least-squares fit that replaces FITPACK ``splprep``.
     """
@@ -102,13 +112,6 @@ class GeometryConfig:
     num_samples: int = 100
     min_cloud_points: int = 100
     min_edge_points: int = 20
-    # 65536 covers 21% of a 640x480 frame -- comfortably above any real
-    # actuator mask (typical masks are 10-60k px), so row-biased truncation
-    # (CurvatureProfile.truncated) should not fire in practice; pathological
-    # all-foreground masks set the flag. Budgets are clamped to H*W.
-    # Perf on v5e-1 (fused with UNet64): 4.4 ms @32768, 6.1 ms @65536,
-    # 11.8 ms @131072 -- the per-bin top_k over the gather budget dominates.
-    max_points: int = 65536
     max_per_bin: int = 128
     num_ctrl: int = 16
     default_depth_scale: float = 0.001  # server.py:59

@@ -138,6 +138,32 @@ def test_streaming_batches_surface_decode_errors(tmp_path):
         list(StreamingBatches(ds, [0, 1], 2, shuffle=False))
 
 
+def test_scan_epoch_matches_stream(tmp_path, arrays):
+    """The one-dispatch-per-epoch lax.scan path and the per-batch loop are
+    the same computation: same shuffle order (shared epoch_order + seed),
+    same losses/metrics to float tolerance."""
+    res_scan = trainer.train_model(
+        tiny_cfg(tmp_path, epochs=2, checkpoint_dir=f"{tmp_path}/c1",
+                 epoch_mode="scan"),
+        TINY_MODEL, arrays=arrays, register=False)
+    res_stream = trainer.train_model(
+        tiny_cfg(tmp_path, epochs=2, checkpoint_dir=f"{tmp_path}/c2",
+                 epoch_mode="stream"),
+        TINY_MODEL, arrays=arrays, register=False)
+    h_scan = tracking.get_metric_history(res_scan.run_id, "train_loss")
+    h_stream = tracking.get_metric_history(res_stream.run_id, "train_loss")
+    np.testing.assert_allclose(
+        [h["value"] for h in h_scan], [h["value"] for h in h_stream],
+        rtol=1e-4,
+    )
+    # mIoU thresholds predictions at 0.5, so float-order differences can
+    # flip individual pixels -- compare loosely
+    np.testing.assert_allclose(
+        res_scan.final_metrics["miou"], res_stream.final_metrics["miou"],
+        atol=5e-3,
+    )
+
+
 def test_train_model_streams_from_disk(tmp_path):
     synthetic.generate_dataset(tmp_path / "ds", n=8, h=64, w=64)
     cfg = tiny_cfg(tmp_path, epochs=1, dataset_dir=str(tmp_path / "ds"))
