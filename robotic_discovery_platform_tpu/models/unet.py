@@ -30,11 +30,47 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 from robotic_discovery_platform_tpu.utils.config import ModelConfig
 
 DType = Any
+
+
+def upsample_align_corners(x, h: int, w: int):
+    """Bilinear 2D resize with ``align_corners=True`` sampling -- the exact
+    semantics of the reference decoder's ``nn.Upsample(scale_factor=2,
+    mode="bilinear", align_corners=True)`` (pkg/segmentation_model.py:58-60).
+
+    ``jax.image.resize`` samples half-pixel centers (align_corners=False),
+    a subtly different grid; matching torch's grid exactly is what lets
+    trained reference checkpoints import with bit-comparable outputs
+    (tools/import_torch_weights.py, tests/test_torch_parity.py).
+
+    Implemented as two small dense interpolation matmuls over the static
+    spatial dims -- MXU-friendly, fuses cleanly under jit.
+    """
+    b, ih, iw, c = x.shape
+
+    def interp_matrix(out: int, inp: int):
+        if out == 1 or inp == 1:
+            pos = np.zeros((out,))
+        else:
+            pos = np.arange(out) * (inp - 1) / (out - 1)
+        i0 = np.clip(np.floor(pos).astype(int), 0, inp - 1)
+        i1 = np.minimum(i0 + 1, inp - 1)
+        frac = (pos - i0).astype(np.float32)
+        m = np.zeros((out, inp), np.float32)
+        np.add.at(m, (np.arange(out), i0), 1.0 - frac)
+        np.add.at(m, (np.arange(out), i1), frac)
+        return jnp.asarray(m, x.dtype)
+
+    y = jnp.einsum("Hh,bhwc->bHwc", interp_matrix(h, ih), x,
+                   preferred_element_type=jnp.float32)
+    y = jnp.einsum("Ww,bhwc->bhWc", interp_matrix(w, iw), y,
+                   preferred_element_type=jnp.float32)
+    return y.astype(x.dtype)
 
 
 def _norm(norm: str, dtype: DType, train: bool, features: int):
@@ -104,7 +140,8 @@ class Up(nn.Module):
     def __call__(self, x, skip, train: bool = False):
         b, h, w, c = skip.shape
         if self.bilinear:
-            x = jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="bilinear")
+            # align_corners=True grid, matching the reference decoder exactly
+            x = upsample_align_corners(x, h, w)
             mid = (x.shape[3] + c) // 2
             x = jnp.concatenate([skip, x.astype(skip.dtype)], axis=-1)
             return DoubleConv(self.features, mid_features=mid,

@@ -119,11 +119,12 @@ class PallasUNet:
         return x
 
     def _up(self, x, skip, layer):
+        from robotic_discovery_platform_tpu.models.unet import (
+            upsample_align_corners)
+
         b, h, w, c = skip.shape
         if self.model.bilinear:
-            x = jax.image.resize(
-                x, (x.shape[0], h, w, x.shape[3]), method="bilinear"
-            )
+            x = upsample_align_corners(x, h, w)
         else:
             wk, bias = layer["convt"]
             x = pconv.conv_transpose2x2(

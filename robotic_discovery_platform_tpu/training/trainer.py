@@ -245,9 +245,13 @@ def train_model(
         raise ValueError(
             f"epoch_mode must be auto|scan|stream, got {cfg.epoch_mode!r}"
         )
-    data_bytes = 0 if arrays is None else (
-        np.asarray(xs).nbytes + np.asarray(ys).nbytes
-    )
+    def _nbytes(a) -> int:
+        # no np.asarray here: that would copy (or device-fetch) the whole
+        # dataset just to read a byte count
+        return int(getattr(a, "nbytes",
+                           np.prod(np.shape(a)) * np.dtype(np.float32).itemsize))
+
+    data_bytes = 0 if arrays is None else _nbytes(xs) + _nbytes(ys)
     fits = data_bytes <= cfg.scan_max_bytes
     use_scan = (
         ds is None and mesh is None

@@ -1,0 +1,102 @@
+"""The full autonomous MLOps loop, end to end in one test:
+
+train v1 -> serve it over real gRPC -> stream frames (metrics CSV fills) ->
+coverage drifts -> drift-gated retraining trains + registers v2 and promotes
+it to @staging -> a restarted server resolves the NEW version.
+
+This is the loop the reference documents but leaves manual and partially
+decorative (its server reads /latest, so staging promotion had no effect --
+SURVEY.md section 2.1 "retraining pipeline"; operator flow README.md:155-169).
+Here every hop is load-bearing and asserted.
+"""
+
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+from robotic_discovery_platform_tpu.serving import client as client_lib
+from robotic_discovery_platform_tpu.serving import server as server_lib
+from robotic_discovery_platform_tpu.serving.metrics import HEADER
+from robotic_discovery_platform_tpu.training import synthetic
+from robotic_discovery_platform_tpu.utils.config import (
+    ClientConfig,
+    DriftConfig,
+    ModelConfig,
+    ServerConfig,
+    TrainConfig,
+)
+from robotic_discovery_platform_tpu.workflows import retraining
+
+TINY = ModelConfig(base_features=8, compute_dtype="float32")
+
+
+@pytest.mark.slow
+def test_autonomous_loop(tmp_path):
+    uri = f"file:{tmp_path}/mlruns"
+    imgs, masks = synthetic.generate_arrays(8, 64, 64, seed=5)
+    arrays = (imgs.astype(np.float32) / 255.0,
+              masks.astype(np.float32) / 255.0)
+    train_cfg = TrainConfig(
+        epochs=1, batch_size=4, img_size=32, validation_split=0.25,
+        tracking_uri=uri, checkpoint_dir=f"{tmp_path}/ckpt",
+    )
+
+    # 1) initial training run registers v1 and promotes it to @staging
+    first = retraining.run_retraining_pipeline(train_cfg, TINY, arrays=arrays)
+    assert first.succeeded and first.version == 1
+
+    # 2) serve v1 and stream real frames through the wire; the server
+    # appends one metrics row per frame
+    metrics_csv = tmp_path / "metrics.csv"
+    server_cfg = ServerConfig(
+        address="localhost:0", tracking_uri=uri,
+        metrics_csv=str(metrics_csv), metrics_flush_every=1,
+        calibration_path=str(tmp_path / "missing.npz"),
+    )
+    server, servicer = server_lib.build_server(server_cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        results = client_lib.run_client(
+            ClientConfig(server_address=f"localhost:{port}"),
+            source=SyntheticSource(width=160, height=120, n_frames=6),
+            max_frames=6,
+        )
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+    assert len(results) == 6
+    rows = metrics_csv.read_text().splitlines()
+    assert rows[0] == HEADER and len(rows) == 7
+
+    # 3) the world changes: coverage collapses 80% in later traffic
+    served_cov = float(rows[1].split(",")[-1])
+    drifted_cov = max(served_cov * 0.2, 0.5)
+    with open(metrics_csv, "a") as f:
+        for i in range(14):
+            f.write(f"2026-07-30 12:00:{i:02d}.0,0.1,0.2,{drifted_cov}\n")
+
+    # 4) the drift detector notices and triggers retraining, which registers
+    # v2 and moves @staging forward
+    drift_cfg = DriftConfig(
+        metrics_csv=str(metrics_csv), min_rows=20,
+        report_path=str(tmp_path / "report.png"),
+    )
+    result = retraining.run_if_drifted(drift_cfg, train_cfg, TINY,
+                                       arrays=arrays)
+    assert result is not None and result.succeeded
+    assert result.version == 2 and result.promoted_alias == "staging"
+    assert (tmp_path / "report.png").exists()
+
+    # 5) a restarted server resolves @staging -> v2, not the original model
+    tracking.set_tracking_uri(uri)
+    v2_path = tracking.resolve_model_uri("models:/Actuator-Segmenter@staging")
+    assert v2_path == tracking.resolve_model_uri("models:/Actuator-Segmenter/2")
+    model2, vars2 = server_lib.resolve_serving_model(server_cfg)
+    _, vars_v2 = tracking.load_model("models:/Actuator-Segmenter/2")
+    leaves_a = [np.asarray(x) for x in
+                __import__("jax").tree.leaves(vars2["params"])]
+    leaves_b = [np.asarray(x) for x in
+                __import__("jax").tree.leaves(vars_v2["params"])]
+    assert all(np.array_equal(a, b) for a, b in zip(leaves_a, leaves_b))
