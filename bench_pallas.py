@@ -13,6 +13,13 @@ Same chained-scan timing as bench.py (see its docstring): K data-dependent
 kernel applications inside one compiled ``lax.scan``, one host fetch, minus
 the independently measured fetch round-trip. bf16 inputs / f32 accumulation,
 matching serving.
+
+Caveat: the per-shape chains need a shape-preserving feedback transform
+(tile/slice) whose overhead rides on both sides of each comparison; at
+sub-millisecond scales the per-shape ratios vary noticeably between runs.
+Treat individual rows as indicative, the aggregate picture and the
+``full_forward_b1_256`` row (the real dispatch-policy evidence, stable
+across runs) as the conclusions.
 """
 
 from __future__ import annotations

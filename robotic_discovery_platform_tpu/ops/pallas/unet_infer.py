@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from robotic_discovery_platform_tpu.models.unet import upsample_align_corners
 from robotic_discovery_platform_tpu.ops.pallas import conv as pconv
 
 # Measured v5e crossover (see tests/test_pallas.py bench + BENCH notes):
@@ -119,9 +120,6 @@ class PallasUNet:
         return x
 
     def _up(self, x, skip, layer):
-        from robotic_discovery_platform_tpu.models.unet import (
-            upsample_align_corners)
-
         b, h, w, c = skip.shape
         if self.model.bilinear:
             x = upsample_align_corners(x, h, w)

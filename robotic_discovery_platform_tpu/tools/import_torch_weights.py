@@ -62,13 +62,6 @@ def _flax_slot_order(cfg: ModelConfig):
     yield ("Conv_0",), "head"
 
 
-def _tree_set(tree: dict, path: tuple, value) -> None:
-    node = tree
-    for key in path[:-1]:
-        node = node[key]
-    node[path[-1]] = value
-
-
 def _tree_get(tree: dict, path: tuple):
     node = tree
     for key in path:

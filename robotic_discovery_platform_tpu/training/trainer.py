@@ -200,6 +200,12 @@ def train_model(
 
     if arrays is not None:
         xs, ys = arrays
+        # normalize to ndarrays once: the index-array batching below needs
+        # fancy indexing (free for inputs that are already numpy/jax arrays)
+        if not hasattr(xs, "nbytes"):
+            xs = np.asarray(xs, np.float32)
+        if not hasattr(ys, "nbytes"):
+            ys = np.asarray(ys, np.float32)
         n_samples = len(xs)
         ds = None
     else:
@@ -248,8 +254,9 @@ def train_model(
     def _nbytes(a) -> int:
         # no np.asarray here: that would copy (or device-fetch) the whole
         # dataset just to read a byte count
-        return int(getattr(a, "nbytes",
-                           np.prod(np.shape(a)) * np.dtype(np.float32).itemsize))
+        if hasattr(a, "nbytes"):
+            return int(a.nbytes)
+        return int(np.prod(np.shape(a)) * np.dtype(np.float32).itemsize)
 
     data_bytes = 0 if arrays is None else _nbytes(xs) + _nbytes(ys)
     fits = data_bytes <= cfg.scan_max_bytes

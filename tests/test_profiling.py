@@ -1,0 +1,37 @@
+"""Profiling helpers: StageTimer math and a real jax.profiler capture
+(SURVEY.md section 5.1 -- the reference reserves proc_time_ms and imports
+time but never measures anything)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from robotic_discovery_platform_tpu.utils.profiling import StageTimer, jax_trace
+
+
+def test_stage_timer_accumulates():
+    t = StageTimer()
+    for _ in range(3):
+        with t.stage("decode"):
+            time.sleep(0.01)
+    with t.stage("device"):
+        time.sleep(0.02)
+    s = t.summary()
+    assert s["decode"]["count"] == 3
+    assert s["decode"]["mean_ms"] >= 10.0
+    assert t.last_ms("decode", "device") >= 30.0
+    assert t.mean_ms("missing") == 0.0
+
+
+def test_jax_trace_captures(tmp_path):
+    d = tmp_path / "trace"
+    with jax_trace(str(d)):
+        jnp.square(jnp.arange(64.0)).block_until_ready()
+    captured = list(d.rglob("*"))
+    assert any(p.is_file() for p in captured), "no trace files written"
+
+
+def test_jax_trace_noop_without_dir():
+    with jax_trace(None):
+        pass  # must not require jax.profiler state

@@ -243,3 +243,48 @@ def test_batched_results_match_single_frame(batching_server, registered_model,
     assert float(batched.profile.mean_curvature) == pytest.approx(
         float(single.profile.mean_curvature), rel=1e-4, abs=1e-6
     )
+
+
+def test_dispatcher_delivers_failures_and_survives():
+    """A failing batched analysis reaches every waiting caller as an
+    exception and the collector thread keeps serving later batches."""
+    import threading
+
+    from robotic_discovery_platform_tpu.serving.batching import BatchDispatcher
+
+    calls = {"n": 0}
+
+    def flaky_analyze(frames, depths, intr, scales):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected analyze failure")
+        return {"coverage": np.full((len(frames),), 42.0)}
+
+    d = BatchDispatcher(flaky_analyze, window_ms=20.0, max_batch=4)
+    frame = np.zeros((8, 8, 3), np.uint8)
+    depth = np.zeros((8, 8), np.uint16)
+    k = np.eye(3, dtype=np.float32)
+
+    errors, oks = [], []
+
+    def submit_once():
+        try:
+            oks.append(d.submit(frame, depth, k, 0.001))
+        except RuntimeError as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=submit_once) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    # the first dispatched batch failed: every member of it got the error,
+    # any frame that missed that batch succeeded on the next dispatch
+    assert errors and all("injected" in str(e) for e in errors)
+    assert len(errors) + len(oks) == 3
+    # the dispatcher still works after the failure
+    out = d.submit(frame, depth, k, 0.001)
+    assert float(out["coverage"]) == 42.0
+    d.stop()
+    with pytest.raises(RuntimeError):
+        d.submit(frame, depth, k, 0.001)

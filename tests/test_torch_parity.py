@@ -71,8 +71,6 @@ def test_convtranspose_import_flip():
         want = tl(x).numpy()  # [2, 4, 10, 14]
 
     fl = nn.ConvTranspose(4, (2, 2), strides=(2, 2))
-    variables = fl.init(jax.random.key(0),
-                        jnp.zeros((1, 5, 7, 6), jnp.float32))
     w = tl.weight.detach().numpy()  # [Cin, Cout, 2, 2]
     variables = {
         "params": {
