@@ -9,6 +9,7 @@ from robotic_discovery_platform_tpu.parallel.mesh import (
 )
 from robotic_discovery_platform_tpu.parallel.dp import (
     parallelize_training,
+    put_global_batch,
     shard_map_train_step,
 )
 
@@ -18,6 +19,7 @@ __all__ = [
     "initialize_distributed",
     "make_mesh",
     "parallelize_training",
+    "put_global_batch",
     "replicated",
     "shard_map_train_step",
     "shard_pytree",

@@ -63,6 +63,37 @@ def _state_shardings(mesh: Mesh, state, tp: bool, tp_min_channels: int):
     )
 
 
+def put_global_batch(mesh: Mesh, x, spatial: bool = False):
+    """Place a host-side global batch onto the mesh's "data" axis.
+
+    Single-process: a plain transfer (GSPMD shards it). Multi-host: every
+    process holds the same global batch (loaders are seed-deterministic),
+    and each contributes its contiguous row block to the global array via
+    ``jax.make_array_from_process_local_data`` -- rows map to processes in
+    device order because ``make_mesh`` builds from ``jax.devices()``.
+    """
+    import jax.numpy as jnp
+
+    sharding = mesh_lib.batch_sharding(mesh, spatial=spatial)
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(x), sharding)
+    procs = jax.process_count()
+    data = dict(mesh.shape).get("data", 1)
+    if data % procs:
+        raise ValueError(
+            f"multi-host batching needs the data axis ({data}) to be a "
+            f"multiple of the process count ({procs}) so each process owns "
+            "a contiguous row block"
+        )
+    if x.shape[0] % procs:
+        raise ValueError(
+            f"global batch {x.shape[0]} not divisible by {procs} processes"
+        )
+    per = x.shape[0] // procs
+    lo = jax.process_index() * per
+    return jax.make_array_from_process_local_data(sharding, x[lo:lo + per])
+
+
 def parallelize_training(
     mesh: Mesh,
     model,

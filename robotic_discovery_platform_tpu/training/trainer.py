@@ -275,12 +275,22 @@ def train_model(
             "per-batch path", data_bytes / 2**30,
         )
 
-    if mesh is not None:
-        from robotic_discovery_platform_tpu.parallel import parallelize_training
+    # Multi-host: every process runs the identical program; process 0 alone
+    # writes tracking, checkpoints, and the registry. DP/SP state is
+    # replicated so process 0 can fetch it; tensor-parallel state spanning
+    # hosts would need orbax multi-host checkpointing (not wired here).
+    is_main = jax.process_index() == 0
 
-        train_step, eval_step, state = parallelize_training(
+    if mesh is not None:
+        from robotic_discovery_platform_tpu import parallel
+
+        train_step, eval_step, state = parallel.parallelize_training(
             mesh, model, tx, loss_fn, state, donate=cfg.donate_state
         )
+        spatial_on = dict(mesh.shape).get("spatial", 1) > 1
+
+        def to_device(b):
+            return parallel.put_global_batch(mesh, b, spatial=spatial_on)
     elif use_scan:
         train_epoch, eval_epoch = make_epoch_runners(
             model, tx, loss_fn, donate=cfg.donate_state
@@ -288,6 +298,8 @@ def train_model(
     else:
         train_step = make_train_step(model, tx, loss_fn, donate=cfg.donate_state)
         eval_step = make_eval_step(model, loss_fn)
+    if mesh is None:
+        to_device = jnp.asarray
 
     divisor = mesh.shape.get("data", 1) if mesh is not None else 1
     # round the global batch up to a multiple of the data-parallel world size
@@ -329,35 +341,45 @@ def train_model(
         def run_val():
             agg: dict[str, list] = {}
             for bx, by in val_batches:
-                m = eval_step(state, jnp.asarray(bx), jnp.asarray(by))
+                m = eval_step(state, to_device(bx), to_device(by))
                 for k, v in m.items():
                     agg.setdefault(k, []).append(float(v))
             return {k: float(np.mean(v)) for k, v in agg.items()}
 
-    tracking.set_tracking_uri(cfg.tracking_uri)
-    tracking.set_experiment(cfg.experiment_name)
+    if is_main:
+        tracking.set_tracking_uri(cfg.tracking_uri)
+        tracking.set_experiment(cfg.experiment_name)
+        run_ctx = tracking.start_run()
+    else:
+        import contextlib
+
+        run_ctx = contextlib.nullcontext(
+            tracking.ActiveRun(f"process-{jax.process_index()}")
+        )
 
     registry_version = None
     final_metrics: dict = {}
 
-    with tracking.start_run() as run:
-        tracking.log_params(
-            {
-                # exact reference param-name surface (train_segmenter.py:119-128)
-                "learning_rate": cfg.learning_rate,
-                "batch_size": batch_size,
-                "epochs": cfg.epochs,
-                "validation_split": cfg.validation_split,
-                "image_size": cfg.img_size,
-                "optimizer": "adam",
-                "loss": cfg.loss,
-                "model": "UNet",
-                "bilinear": model_cfg.bilinear,
-                "base_features": model_cfg.base_features,
-                "backend": jax.default_backend(),
-                "num_devices": divisor,
-            }
-        )
+    with run_ctx as run:
+        if is_main:
+            tracking.log_params(
+                {
+                    # exact reference param-name surface
+                    # (train_segmenter.py:119-128)
+                    "learning_rate": cfg.learning_rate,
+                    "batch_size": batch_size,
+                    "epochs": cfg.epochs,
+                    "validation_split": cfg.validation_split,
+                    "image_size": cfg.img_size,
+                    "optimizer": "adam",
+                    "loss": cfg.loss,
+                    "model": "UNet",
+                    "bilinear": model_cfg.bilinear,
+                    "base_features": model_cfg.base_features,
+                    "backend": jax.default_backend(),
+                    "num_devices": divisor,
+                }
+            )
 
         start_epoch = min(int(state.epoch), cfg.epochs)
         if int(state.epoch) >= cfg.epochs:
@@ -378,7 +400,7 @@ def train_model(
                 train_losses = []
                 for bx, by in train_batches:
                     state, loss = train_step(
-                        state, jnp.asarray(bx), jnp.asarray(by)
+                        state, to_device(bx), to_device(by)
                     )
                     train_losses.append(loss)
                 train_loss = float(np.mean([float(l) for l in train_losses]))
@@ -386,10 +408,11 @@ def train_model(
             val = run_val()
             final_metrics = val
 
-            tracking.log_metric("train_loss", train_loss, step=epoch)
-            tracking.log_metric("val_loss", val["loss"], step=epoch)
-            tracking.log_metric("val_miou", val["miou"], step=epoch)
-            tracking.log_metric("val_dice", val["dice"], step=epoch)
+            if is_main:
+                tracking.log_metric("train_loss", train_loss, step=epoch)
+                tracking.log_metric("val_loss", val["loss"], step=epoch)
+                tracking.log_metric("val_miou", val["miou"], step=epoch)
+                tracking.log_metric("val_dice", val["dice"], step=epoch)
             log.info(
                 "epoch %d/%d train_loss=%.4f val_loss=%.4f miou=%.4f (%.1fs)",
                 epoch + 1, cfg.epochs, train_loss, val["loss"], val["miou"],
@@ -404,25 +427,27 @@ def train_model(
                 best_stats = jax.device_get(state.batch_stats)
 
             state = state.replace(epoch=jnp.asarray(epoch + 1, jnp.int32))
-            host_state = jax.device_get(state)
-            ckpt.save(
-                epoch + 1,
-                {
-                    "state": host_state,
-                    "best_params": (
-                        best_params if best_params is not None
-                        else host_state.params
-                    ),
-                    "best_stats": (
-                        best_stats if best_stats is not None
-                        else host_state.batch_stats
-                    ),
-                },
-            )
+            if is_main:
+                host_state = jax.device_get(state)
+                ckpt.save(
+                    epoch + 1,
+                    {
+                        "state": host_state,
+                        "best_params": (
+                            best_params if best_params is not None
+                            else host_state.params
+                        ),
+                        "best_stats": (
+                            best_stats if best_stats is not None
+                            else host_state.batch_stats
+                        ),
+                    },
+                )
 
-        tracking.log_metric("best_val_loss", float(state.best_val_loss))
+        if is_main:
+            tracking.log_metric("best_val_loss", float(state.best_val_loss))
 
-        if register and best_params is not None:
+        if is_main and register and best_params is not None:
             variables = {"params": best_params}
             if best_stats:
                 variables["batch_stats"] = best_stats

@@ -7,7 +7,15 @@ processes' devices, and one data-parallel train step whose gradient
 allreduce crosses the process boundary (the DCN-analogue on this CPU
 harness). Prints one JSON line the parent asserts on.
 
-Usage: python multihost_worker.py <coordinator> <num_processes> <process_id>
+Two modes:
+
+- ``step`` (default): one hand-built data-parallel train step through
+  ``parallel.dp`` -- the minimal collective-plane check.
+- ``trainer <workdir>``: the REAL ``train_model`` entry point with a global
+  mesh -- per-process batch sharding via ``put_global_batch``, tracking /
+  checkpoints / registry written by process 0 only.
+
+Usage: python multihost_worker.py <coordinator> <nproc> <pid> [mode] [dir]
 """
 
 import json
@@ -18,8 +26,51 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def run_trainer_mode(workdir: str) -> dict:
+    import numpy as np
+
+    import jax
+
+    from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+    from robotic_discovery_platform_tpu.training import synthetic, trainer
+    from robotic_discovery_platform_tpu.utils.config import (
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(data=jax.device_count(), spatial=1, model=1)
+    )
+    imgs, masks = synthetic.generate_arrays(8, 32, 32, seed=0)
+    arrays = (imgs.astype(np.float32) / 255.0,
+              masks.astype(np.float32) / 255.0)
+    cfg = TrainConfig(
+        epochs=1, batch_size=4, img_size=32, validation_split=0.25,
+        learning_rate=1e-3,
+        tracking_uri=f"file:{workdir}/mlruns",
+        checkpoint_dir=f"{workdir}/ckpt",
+    )
+    res = trainer.train_model(
+        cfg, ModelConfig(base_features=8, compute_dtype="float32"),
+        arrays=arrays, mesh=mesh,
+    )
+    # process 0 spends extra wall-clock on checkpoint + registry IO; without
+    # this barrier the other process exits first and the distributed
+    # shutdown barrier times out (standard multihost exit hygiene)
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("train_model done")
+    return {
+        "registry_version": res.registry_version,
+        "best_val_loss": res.best_val_loss,
+        "val_miou": res.final_metrics["miou"],
+    }
+
+
 def main() -> None:
     coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "step"
 
     # Same virtual-CPU-backend forcing as tests/conftest.py (the axon
     # sitecustomize re-registers the TPU backend at interpreter start).
@@ -39,6 +90,12 @@ def main() -> None:
     mesh_lib.initialize_distributed(coordinator, nproc, pid)
     assert jax.process_count() == nproc, jax.process_count()
     assert jax.default_backend() == "cpu", jax.default_backend()
+
+    if mode == "trainer":
+        out = run_trainer_mode(sys.argv[5])
+        out.update(pid=pid, processes=jax.process_count())
+        print(json.dumps(out), flush=True)
+        return
 
     import numpy as np
     import optax

@@ -30,20 +30,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_train_step():
+def _launch_cluster(extra_args=()):
     coordinator = f"localhost:{_free_port()}"
     env = {
         k: v for k, v in os.environ.items()
         if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
     }
-    procs = [
+    return [
         subprocess.Popen(
-            [sys.executable, str(WORKER), coordinator, "2", str(pid)],
+            [sys.executable, str(WORKER), coordinator, "2", str(pid),
+             *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
         )
         for pid in range(2)
     ]
+
+
+def _collect(procs):
     # collect BOTH workers before asserting anything: an early assert for
     # worker 0 would leak worker 1 blocked in distributed init for minutes
     results = []
@@ -65,7 +68,13 @@ def test_two_process_train_step():
         for i, (rc, _, err) in enumerate(results) if rc != 0
     ]
     assert not failures, "\n---\n".join(failures)
-    outs = [json.loads(out.strip().splitlines()[-1]) for _, out, _ in results]
+    return [json.loads(out.strip().splitlines()[-1]) for _, out, _ in results]
+
+
+@pytest.mark.slow
+def test_two_process_train_step():
+    procs = _launch_cluster()
+    outs = _collect(procs)
 
     by_pid = {o["pid"]: o for o in outs}
     assert set(by_pid) == {0, 1}
@@ -77,3 +86,27 @@ def test_two_process_train_step():
     # the allreduce makes the replicated loss/metrics identical across hosts
     assert by_pid[0]["loss"] == pytest.approx(by_pid[1]["loss"], rel=1e-6)
     assert by_pid[0]["val_loss"] == pytest.approx(by_pid[1]["val_loss"], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_train_model(tmp_path):
+    """The REAL trainer entry point across a 2-process cluster: per-process
+    batch sharding (parallel.put_global_batch), identical replicated
+    results on both hosts, and tracking/checkpoint/registry written by
+    process 0 only."""
+    procs = _launch_cluster(("trainer", str(tmp_path)))
+    outs = _collect(procs)
+    by_pid = {o["pid"]: o for o in outs}
+    assert set(by_pid) == {0, 1}
+    # process 0 registered; process 1 computed identically but wrote nothing
+    assert by_pid[0]["registry_version"] == 1
+    assert by_pid[1]["registry_version"] is None
+    assert by_pid[0]["best_val_loss"] == pytest.approx(
+        by_pid[1]["best_val_loss"], rel=1e-6
+    )
+    assert by_pid[0]["val_miou"] == pytest.approx(
+        by_pid[1]["val_miou"], rel=1e-5
+    )
+    # the store and checkpoints exist exactly once, under process 0's run
+    assert (tmp_path / "mlruns").is_dir()
+    assert (tmp_path / "ckpt").is_dir()
