@@ -163,6 +163,37 @@ def make_epoch_runners(model, tx, loss_fn: Callable, donate: bool = True):
     )
 
 
+#: Independent device buffers for a pytree: safe to hold across later
+#: donated train steps, and checkpointable as (possibly sharded) global
+#: arrays. jit outputs never alias non-donated inputs, so every leaf is a
+#: fresh buffer with its input sharding preserved. Module-level so the
+#: compiled copy program is cached across improving epochs.
+_copy_tree = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+
+def _fetch_to_host(tree):
+    """``device_get`` that first re-replicates any non-fully-replicated
+    leaves (tensor-parallel shards) through ONE collective identity jit.
+    Must be called on EVERY process of a multi-host job (the re-replication
+    is an all-gather)."""
+
+    def sharded(a):
+        return (
+            hasattr(a, "is_fully_replicated") and not a.is_fully_replicated
+        )
+
+    if any(sharded(a) for a in jax.tree.leaves(tree)):
+        from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+
+        out_shardings = jax.tree.map(
+            lambda a: mesh_lib.replicated(a.sharding.mesh)
+            if sharded(a) else a.sharding,
+            tree,
+        )
+        tree = jax.jit(lambda t: t, out_shardings=out_shardings)(tree)
+    return jax.device_get(tree)
+
+
 @dataclass
 class TrainResult:
     run_id: str
@@ -189,7 +220,10 @@ def train_model(
         arrays: optional in-memory ((xs, ys)) dataset overriding
             ``cfg.dataset_dir`` (tests, synthetic smoke runs).
         resume: restore the latest orbax checkpoint under
-            ``cfg.checkpoint_dir`` and continue from its epoch.
+            ``cfg.checkpoint_dir`` and continue from its epoch. In a
+            multi-host job the restore is collective (every process calls
+            it, sharded leaves land on their home devices), so
+            ``checkpoint_dir`` must be shared storage across hosts.
         mesh: optional ``jax.sharding.Mesh``; when given, batches are sharded
             over the mesh's "data" axis and gradients allreduce over ICI
             (see parallel/).
@@ -224,25 +258,11 @@ def train_model(
     loss_fn = losses_lib.make_loss_fn(cfg.loss, cfg.dice_weight)
     state = create_state(model, tx, jax.random.key(cfg.seed), cfg.img_size)
 
+    # Best-so-far candidate params/stats, held as independent DEVICE buffers
+    # (_copy_tree) so they survive donation of the live state and checkpoint
+    # as sharded global arrays under tensor parallelism.
     best_params = None
     best_stats = None
-
-    # Checkpoints carry the best-so-far candidate alongside the live state so
-    # a resumed run registers the params that actually achieved
-    # ``best_val_loss``, not whatever the last epoch happened to hold.
-    ckpt = CheckpointManager(cfg.checkpoint_dir, keep=cfg.keep_checkpoints)
-    if resume and ckpt.latest_step() is not None:
-        template = {
-            "state": state,
-            "best_params": state.params,
-            "best_stats": state.batch_stats,
-        }
-        restored = ckpt.restore(template)
-        state = restored["state"]
-        log.info("resumed from checkpoint at epoch %d", int(state.epoch))
-        if np.isfinite(float(state.best_val_loss)):
-            best_params = jax.device_get(restored["best_params"])
-            best_stats = jax.device_get(restored["best_stats"])
 
     # Whole-epoch lax.scan mode: single device with the dataset resident in
     # HBM (in-memory arrays, no mesh). One dispatch + one fetch per epoch
@@ -276,16 +296,19 @@ def train_model(
         )
 
     # Multi-host: every process runs the identical program; process 0 alone
-    # writes tracking, checkpoints, and the registry. DP/SP state is
-    # replicated so process 0 can fetch it; tensor-parallel state spanning
-    # hosts would need orbax multi-host checkpointing (not wired here).
+    # writes tracking and the registry. Checkpoint save/restore are
+    # COLLECTIVE -- every process calls them and orbax coordinates its own
+    # cross-host barriers, writing/reading per-host shards (tensor-parallel
+    # state included). ``checkpoint_dir`` must be shared storage (GCS or a
+    # shared filesystem) in a multi-host job, as is standard on TPU pods.
     is_main = jax.process_index() == 0
 
     if mesh is not None:
         from robotic_discovery_platform_tpu import parallel
 
         train_step, eval_step, state = parallel.parallelize_training(
-            mesh, model, tx, loss_fn, state, donate=cfg.donate_state
+            mesh, model, tx, loss_fn, state, donate=cfg.donate_state,
+            tp_min_channels=cfg.tp_min_channels,
         )
         spatial_on = dict(mesh.shape).get("spatial", 1) > 1
 
@@ -300,6 +323,45 @@ def train_model(
         eval_step = make_eval_step(model, loss_fn)
     if mesh is None:
         to_device = jnp.asarray
+        def scalarize(v, dtype):
+            return jnp.asarray(v, dtype)
+    else:
+        from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+
+        _rep = mesh_lib.replicated(mesh)
+        def scalarize(v, dtype):
+            # progress counters live replicated on the mesh so the saved
+            # state is a consistent global array on every host
+            return jax.device_put(jnp.asarray(v, dtype), _rep)
+
+    # Checkpoints carry the best-so-far candidate alongside the live state so
+    # a resumed run registers the params that actually achieved
+    # ``best_val_loss``, not whatever the last epoch happened to hold.
+    # Restore happens AFTER parallelize_training so the abstract template
+    # carries the final (possibly TP-sharded) shardings and orbax lands each
+    # host's shards directly on its devices.
+    ckpt = CheckpointManager(cfg.checkpoint_dir, keep=cfg.keep_checkpoints)
+    if resume and ckpt.latest_step() is not None:
+        template = {
+            "state": state,
+            "best_params": state.params,
+            "best_stats": state.batch_stats,
+        }
+        if mesh is not None:
+            template = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=a.sharding
+                ),
+                template,
+            )
+        else:
+            template = jax.device_get(template)
+        restored = ckpt.restore(template)
+        state = restored["state"]
+        log.info("resumed from checkpoint at epoch %d", int(state.epoch))
+        if np.isfinite(float(state.best_val_loss)):
+            best_params = restored["best_params"]
+            best_stats = restored["best_stats"]
 
     divisor = mesh.shape.get("data", 1) if mesh is not None else 1
     # round the global batch up to a multiple of the data-parallel world size
@@ -421,44 +483,50 @@ def train_model(
 
             if val["loss"] < float(state.best_val_loss):
                 state = state.replace(
-                    best_val_loss=jnp.asarray(val["loss"], jnp.float32)
+                    best_val_loss=scalarize(val["loss"], jnp.float32)
                 )
-                best_params = jax.device_get(state.params)
-                best_stats = jax.device_get(state.batch_stats)
+                best_params, best_stats = _copy_tree(
+                    (state.params, state.batch_stats)
+                )
 
-            state = state.replace(epoch=jnp.asarray(epoch + 1, jnp.int32))
-            if is_main:
-                host_state = jax.device_get(state)
-                ckpt.save(
-                    epoch + 1,
-                    {
-                        "state": host_state,
-                        "best_params": (
-                            best_params if best_params is not None
-                            else host_state.params
-                        ),
-                        "best_stats": (
-                            best_stats if best_stats is not None
-                            else host_state.batch_stats
-                        ),
-                    },
-                )
+            state = state.replace(epoch=scalarize(epoch + 1, jnp.int32))
+            # Collective: every process calls save; orbax coordinates its
+            # own cross-host barriers and each host writes its shards.
+            ckpt.save(
+                epoch + 1,
+                {
+                    "state": state,
+                    "best_params": (
+                        best_params if best_params is not None
+                        else state.params
+                    ),
+                    "best_stats": (
+                        best_stats if best_stats is not None
+                        else state.batch_stats
+                    ),
+                },
+            )
 
         if is_main:
             tracking.log_metric("best_val_loss", float(state.best_val_loss))
 
-        if is_main and register and best_params is not None:
-            variables = {"params": best_params}
-            if best_stats:
-                variables["batch_stats"] = best_stats
-            registry_version = tracking.log_model(
-                variables, model_cfg,
-                registered_model_name=cfg.registered_model_name,
-            )
-            log.info(
-                "registered %s version %s", cfg.registered_model_name,
-                registry_version,
-            )
+        if register and best_params is not None:
+            # collective all-gather of any TP-sharded leaves, then host fetch
+            # on every process; only process 0 writes the registry
+            host_params = _fetch_to_host(best_params)
+            host_stats = _fetch_to_host(best_stats)
+            if is_main:
+                variables = {"params": host_params}
+                if host_stats:
+                    variables["batch_stats"] = host_stats
+                registry_version = tracking.log_model(
+                    variables, model_cfg,
+                    registered_model_name=cfg.registered_model_name,
+                )
+                log.info(
+                    "registered %s version %s", cfg.registered_model_name,
+                    registry_version,
+                )
 
         run_id = run.info.run_id
 

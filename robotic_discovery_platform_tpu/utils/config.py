@@ -72,6 +72,9 @@ class TrainConfig:
     # TPU-first:
     donate_state: bool = True
     log_every: int = 1
+    # tensor parallelism: shard conv kernels with >= this many output
+    # channels over the mesh "model" axis (see parallel.mesh.tp_param_specs)
+    tp_min_channels: int = 256
     # decode threads for the streaming file loader (StreamingBatches)
     loader_workers: int = 4
     # Epoch execution: "auto" runs whole epochs in one lax.scan dispatch

@@ -110,3 +110,27 @@ def test_two_process_train_model(tmp_path):
     # the store and checkpoints exist exactly once, under process 0's run
     assert (tmp_path / "mlruns").is_dir()
     assert (tmp_path / "ckpt").is_dir()
+
+
+@pytest.mark.slow
+def test_two_process_tp_resume(tmp_path):
+    """Tensor-parallel (dp=2 x tp=2) state spanning both processes is
+    checkpointed SHARDED by a collective orbax save and restored under
+    ``resume=True`` (VERDICT round-2 item 7): a 1-epoch run, then a resumed
+    2-epoch run that restores the cross-host sharded checkpoint and trains
+    exactly one more epoch, registering version 2."""
+    procs = _launch_cluster(("tp_resume", str(tmp_path)))
+    outs = _collect(procs)
+    by_pid = {o["pid"]: o for o in outs}
+    assert set(by_pid) == {0, 1}
+    assert by_pid[0]["v1"] == 1 and by_pid[0]["v2"] == 2
+    assert by_pid[1]["v1"] is None and by_pid[1]["v2"] is None
+    for o in outs:
+        assert o["epochs_run_2"] == 1
+        assert np.isfinite(o["best2"])
+        # resumed best is monotone non-increasing vs the first run's best
+        assert o["best2"] <= o["best1"] + 1e-9
+    assert by_pid[0]["best2"] == pytest.approx(by_pid[1]["best2"], rel=1e-6)
+    assert by_pid[0]["val_miou"] == pytest.approx(
+        by_pid[1]["val_miou"], rel=1e-5
+    )
