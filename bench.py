@@ -83,7 +83,11 @@ def main() -> None:
 
     model = build_unet(ModelConfig())
     variables = init_unet(model, jax.random.key(0))
-    geom_cfg = GeometryConfig()
+    # The serving geometry profile (ServerConfig.geometry_stride=2): pooled
+    # stride-2 decimation, corpus-validated in GEOMETRY_PARITY.json. The
+    # reference-exact stride-1 path is also reported (stride1_b1).
+    geom_cfg = GeometryConfig(stride=2)
+    geom_cfg_exact = GeometryConfig(stride=1)
     on_tpu = pallas_ops.use_pallas()
     pnet = pallas_ops.make_pallas_unet(model, variables) if on_tpu else None
 
@@ -97,30 +101,28 @@ def main() -> None:
     )
     scale = jnp.float32(0.001)
 
-    def make_fused_step(forward, batch: int):
+    def make_fused_step(forward, batch: int, gcfg):
         depth_b = jnp.broadcast_to(depth, (batch, h, w))
         intr_b = jnp.broadcast_to(intrinsics, (batch, 3, 3))
         scale_b = jnp.broadcast_to(scale, (batch,))
 
         def per_frame(mm, dd, kk, ss):
-            return geometry.compute_curvature_profile(mm, dd, kk, ss, geom_cfg)
+            return geometry.compute_curvature_profile(mm, dd, kk, ss, gcfg)
 
         def fused_step(f):  # f: [B, H, W, 3] uint8
             x = pipeline.preprocess(f, 256)
             logits = (forward(x) if forward is not None
                       else model.apply(variables, x, train=False))
             m = pipeline.logits_to_native_masks(logits, h, w)
-            # same batching policy as ops/pipeline._analyze_batch: geometry
-            # unbatched per frame (vmap costs 7x on its top_k selection)
+            # same batching policy as ops/pipeline._analyze_batch: vmap --
+            # the packed-key sort batches as ONE row-batched XLA sort
             if batch == 1:
                 prof = jax.tree.map(
                     lambda a: a[None],
                     per_frame(m[0], depth_b[0], intr_b[0], scale_b[0]),
                 )
             else:
-                prof = jax.lax.map(
-                    lambda args: per_frame(*args), (m, depth_b, intr_b, scale_b)
-                )
+                prof = jax.vmap(per_frame)(m, depth_b, intr_b, scale_b)
             # Data dependency on BOTH the mask and the curvature result so no
             # stage can be dead-code-eliminated across iterations.
             dep = (m & jnp.uint8(1)) ^ (
@@ -130,8 +132,8 @@ def main() -> None:
 
         return fused_step
 
-    def bench(forward, batch: int, rt_ms: float):
-        step = make_fused_step(forward, batch)
+    def bench(forward, batch: int, rt_ms: float, gcfg=None):
+        step = make_fused_step(forward, batch, gcfg or geom_cfg)
 
         @jax.jit
         def chained(f0):
@@ -161,7 +163,12 @@ def main() -> None:
     fps = fps_flax
     if results.get("pallas_b1", 0) > fps_flax:
         best_fwd, fps = pallas_fwd, results["pallas_b1"]
-    # batched serving throughput (cross-stream micro-batching, B frames/step)
+    # reference-exact dense geometry (stride 1) for comparison
+    results["stride1_b1"], _ = bench(best_fwd, 1, rt_ms, geom_cfg_exact)
+    # batched serving throughput (cross-stream micro-batching, B frames/step).
+    # Measured context: the U-Net forward's per-frame cost RISES with batch
+    # on this chip (b1 0.86 -> b8 1.39 ms/frame), so b1 is expected to win;
+    # these numbers document why batching ships disabled.
     for b in (4, 8):
         results[f"batched_b{b}"], _ = bench(best_fwd, b, rt_ms)
 

@@ -51,15 +51,21 @@ class CurvatureProfile(NamedTuple):
     truncated: jnp.ndarray  # scalar bool: per-bin max_per_bin budget bound
 
 
-def deproject(mask, depth, fx, fy, cx, cy, depth_scale):
+def deproject(mask, depth, fx, fy, cx, cy, depth_scale, stride: int = 1):
     """Pinhole deprojection over the dense grid (reference :101-117).
 
     Returns per-pixel (x, y, z) maps plus a validity map; no gathers.
+    ``stride`` > 1 means mask/depth are an s x s pooled view of the native
+    frame: iota coordinates scale by ``stride`` and point at each cell's
+    CENTER ((s-1)/2 offset), which is unbiased for a pooled value that may
+    come from anywhere in the cell (corner coordinates would skew every
+    point up to s-1 native pixels toward the top-left).
     """
     h, w = depth.shape
     dtype = jnp.float32
-    v = jax.lax.broadcasted_iota(dtype, (h, w), 0)
-    u = jax.lax.broadcasted_iota(dtype, (h, w), 1)
+    off = (stride - 1) / 2.0
+    v = jax.lax.broadcasted_iota(dtype, (h, w), 0) * stride + off
+    u = jax.lax.broadcasted_iota(dtype, (h, w), 1) * stride + off
     z = depth.astype(dtype) * jnp.asarray(depth_scale, dtype)
     valid = (mask > 0) & (z > 0)
     x = (u - cx) * z / fx
@@ -100,14 +106,34 @@ def _edge_points(x, y, z, valid, cfg: GeometryConfig):
     )
 
     p = xs.shape[0]
-    key_bin = jnp.where(v, bin_idx, cfg.num_bins)  # invalid sorts last
-    key_negy = jnp.where(v, -ys, big)
-    sorted_bin, _, sorted_idx = jax.lax.sort(
-        (key_bin, key_negy, jnp.arange(p, dtype=jnp.int32)), num_keys=2
+    # ONE packed int32 sort key: (bin << 25) | quantize(descending y, 25b).
+    # A single-key sort halves the comparator work of the previous
+    # (bin, -y) two-key sort -- the sort is the whole pipeline's hot spot.
+    # 25 bits across the frame's valid y-range (<= 51 * 2^25 < 2^31) keeps
+    # ~15 nm selection resolution at 0.5 m spans: quantization can only
+    # reorder exact physical ties, which the reference's argpartition also
+    # breaks arbitrarily (reference :134-140).
+    if (cfg.num_bins + 1) << 25 >= 2**31:
+        raise ValueError(
+            f"num_bins={cfg.num_bins} overflows the packed int32 sort key "
+            "(needs (num_bins + 1) << 25 < 2^31, i.e. num_bins <= 62)"
+        )
+    shift = jnp.int32(1 << 25)
+    y_min = jnp.min(jnp.where(v, ys, big))
+    y_max = jnp.max(jnp.where(v, ys, -big))
+    q_scale = ((1 << 25) - 1) / jnp.maximum(y_max - y_min, 1e-12)
+    qy = jnp.clip(
+        ((y_max - ys) * q_scale).astype(jnp.int32), 0, (1 << 25) - 1
     )
-    bins = jnp.arange(cfg.num_bins, dtype=jnp.int32)
-    starts = jnp.searchsorted(sorted_bin, bins)
-    ends = jnp.searchsorted(sorted_bin, bins, side="right")
+    key = jnp.where(
+        v, bin_idx * shift + qy, jnp.int32(cfg.num_bins) * shift
+    )
+    sorted_key, sorted_idx = jax.lax.sort(
+        (key, jnp.arange(p, dtype=jnp.int32)), num_keys=1
+    )
+    bins = jnp.arange(cfg.num_bins + 1, dtype=jnp.int32)
+    bounds = jnp.searchsorted(sorted_key, bins * shift)
+    starts, ends = bounds[:-1], bounds[1:]
     n_b = (ends - starts).astype(jnp.int32)
     # k_b = max(1, floor(n_b * top_k_percent)), 0 when the bin is empty
     # (reference :138).
@@ -167,7 +193,31 @@ def compute_curvature_profile(
     fx, fy = intrinsics[0, 0], intrinsics[1, 1]
     cx, cy = intrinsics[0, 2], intrinsics[1, 2]
 
-    x, y, z, valid_map = deproject(mask, depth, fx, fy, cx, cy, depth_scale)
+    s = max(1, int(cfg.stride))
+    if s > 1:
+        # Decimate the cloud before the (dominant) packed-key sort: stride 2
+        # quarters the sorted element count. Implemented as an s x s
+        # max-pool of the MASKED depth -- NOT a strided slice, which costs
+        # ~1.8 ms/frame in lane relayout on TPU while reduce_window is
+        # effectively free. Pooling the masked depth keeps the mask & z>0
+        # coupling exact (each pooled cell carries its deepest masked
+        # pixel or is invalid). Accuracy vs the scipy oracle is quantified
+        # per stride in GEOMETRY_PARITY.json.
+        masked_depth = jnp.where(mask > 0, depth, 0)
+        masked_depth = jax.lax.reduce_window(
+            masked_depth,
+            jnp.array(0, masked_depth.dtype),
+            jax.lax.max,
+            (s, s),
+            (s, s),
+            "VALID",
+        )
+        mask = (masked_depth > 0).astype(jnp.uint8)
+        depth = masked_depth
+
+    x, y, z, valid_map = deproject(
+        mask, depth, fx, fy, cx, cy, depth_scale, stride=s
+    )
     cloud_count = jnp.sum(valid_map).astype(jnp.int32)
 
     e_pts, e_w, edge_count, binnable, bin_capped = _edge_points(
@@ -188,10 +238,13 @@ def compute_curvature_profile(
     mean_k = jnp.where(n_kv > 0, jnp.sum(kappa) / jnp.maximum(n_kv, 1), 0.0)
     max_k = jnp.max(jnp.where(k_valid, kappa, 0.0))
 
+    # A strided view sees ~1/s^2 of the native points, so the reference's
+    # native-resolution validity cutoffs (:64-70) scale by s^2 to keep the
+    # same valid/invalid decision boundary.
     ok = (
-        (cloud_count >= cfg.min_cloud_points)
+        (cloud_count * (s * s) >= cfg.min_cloud_points)
         & binnable
-        & (edge_count >= cfg.min_edge_points)
+        & (edge_count * (s * s) >= cfg.min_edge_points)
         & (n_kv > 0)
     )
     zero = jnp.float32(0.0)

@@ -249,12 +249,18 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
 def build_server(
     cfg: ServerConfig = ServerConfig(),
-    geom_cfg: GeometryConfig = GeometryConfig(),
+    geom_cfg: GeometryConfig | None = None,
     warmup_shape: tuple[int, int] | None = None,
 ) -> tuple[grpc.Server, VisionAnalysisService]:
     """Load every resource and return an unstarted (server, servicer).
     Aborts (raises) when the model or calibration is unusable, mirroring the
-    reference's fail-fast startup (server.py:168-170)."""
+    reference's fail-fast startup (server.py:168-170).
+
+    ``geom_cfg`` defaults to the serving geometry profile
+    (``stride=cfg.geometry_stride``); pass an explicit GeometryConfig to
+    override (e.g. stride=1 for reference-exact dense semantics)."""
+    if geom_cfg is None:
+        geom_cfg = GeometryConfig(stride=cfg.geometry_stride)
     model, variables = resolve_serving_model(cfg)
     intrinsics = None
     depth_scale = cfg.default_depth_scale

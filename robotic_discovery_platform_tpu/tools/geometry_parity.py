@@ -1,0 +1,162 @@
+"""Geometry parity corpus: quantify jax-vs-oracle curvature error.
+
+VERDICT round-2 item 5: the single-arc-scene geometry test proved "parity-
+ish"; this tool measures the actual error distribution of the TPU geometry
+engine (ops/geometry.py) against the reference-semantics scipy oracle
+(tests/oracle.py, spec: /root/reference/pkg/geometry_utils.py:42-162) over a
+randomized corpus -- radius, focal length, depth, band thickness, arc
+placement, depth noise, and mask speckle all vary -- and records the
+distribution in GEOMETRY_PARITY.json so test tolerances are set by data,
+not hope.
+
+Each scene is scored at geometry stride 1 (reference-exact dense semantics)
+and stride 2 (the serving fast path: 4x less sort work), so the JSON also
+documents exactly what accuracy the fast path trades.
+
+Usage: python -m robotic_discovery_platform_tpu.tools.geometry_parity
+       [--scenes N] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+
+def random_scene(rng: np.random.Generator):
+    """Randomized arc scene + its analytic ground-truth curvature."""
+    from oracle import make_arc_scene
+
+    params = dict(
+        h=480,
+        w=640,
+        f=float(rng.uniform(450.0, 750.0)),
+        z0=float(rng.uniform(0.3, 0.8)),
+        r_px=float(rng.uniform(150.0, 380.0)),
+        band_px=int(rng.integers(30, 120)),
+        arc_cy_px=float(rng.uniform(40.0, 160.0)),
+    )
+    mask, depth, k, scale, true_k = make_arc_scene(**params)
+
+    # depth noise: +-2 mm gaussian, quantized to the z16 grid
+    noise_mm = float(rng.uniform(0.0, 2.0))
+    if noise_mm > 0:
+        depth = depth.astype(np.int64) + np.round(
+            rng.normal(0.0, noise_mm, depth.shape)
+        ).astype(np.int64)
+        depth = np.clip(depth, 0, 65535).astype(np.uint16)
+
+    # mask speckle: drop a small fraction of mask pixels (sensor dropouts)
+    drop = float(rng.uniform(0.0, 0.05))
+    if drop > 0:
+        mask = mask * (rng.random(mask.shape) > drop).astype(np.uint8)
+
+    params.update(noise_mm=noise_mm, drop=drop)
+    return mask, depth, k, scale, true_k, params
+
+
+def run_corpus(n_scenes: int, seed: int = 0) -> dict:
+    import jax.numpy as jnp
+
+    from oracle import oracle_curvature
+    from robotic_discovery_platform_tpu.ops import geometry
+    from robotic_discovery_platform_tpu.utils.config import GeometryConfig
+
+    fns = {
+        s: geometry.make_jitted_profile(GeometryConfig(stride=s))
+        for s in (1, 2)
+    }
+
+    rng = np.random.default_rng(seed)
+    scenes = []
+    while len(scenes) < n_scenes:
+        mask, depth, k, scale, true_k, params = random_scene(rng)
+        o_mean, o_max, _ = oracle_curvature(mask, depth, k, scale)
+        if o_mean == 0.0:  # oracle declined (degenerate draw); redraw
+            continue
+        rec = {"params": params, "true_curvature": true_k,
+               "oracle": {"mean": o_mean, "max": o_max}}
+        for s, fn in fns.items():
+            p = fn(jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k),
+                   scale)
+            rec[f"stride{s}"] = {
+                "valid": bool(p.valid),
+                "mean": float(p.mean_curvature),
+                "max": float(p.max_curvature),
+                "rel_err_mean": abs(float(p.mean_curvature) - o_mean) / o_mean,
+                "rel_err_max": abs(float(p.max_curvature) - o_max) / o_max,
+            }
+        scenes.append(rec)
+
+    def dist(errs):
+        errs = np.asarray(errs)
+        return {
+            "mean": float(errs.mean()),
+            "p50": float(np.percentile(errs, 50)),
+            "p90": float(np.percentile(errs, 90)),
+            "max": float(errs.max()),
+        }
+
+    def agg(key: str, field: str):
+        return dist([s[key][field] for s in scenes])
+
+    def truth_err(key: str, field: str):
+        return dist([
+            abs(s[key][field] - s["true_curvature"]) / s["true_curvature"]
+            for s in scenes
+        ])
+
+    summary = {}
+    for key in ("oracle", "stride1", "stride2"):
+        entry = {
+            "mean_curvature_vs_truth": truth_err(key, "mean"),
+            "max_curvature_vs_truth": truth_err(key, "max"),
+        }
+        if key != "oracle":
+            entry["valid_frac"] = float(np.mean(
+                [sc[key]["valid"] for sc in scenes]
+            ))
+            entry["mean_curvature_vs_oracle"] = agg(key, "rel_err_mean")
+            entry["max_curvature_vs_oracle"] = agg(key, "rel_err_max")
+        summary[key] = entry
+
+    return {
+        "n_scenes": len(scenes),
+        "seed": seed,
+        "oracle": "tests/oracle.py (reference semantics: 50 bins, top-5%, "
+                  "splprep s=0.1 k=3)",
+        "notes": (
+            "vs_truth: relative error against the analytic arc curvature. "
+            "The jax engine's divergence from the oracle is dominated by "
+            "FITPACK's own truth error; max-curvature is endpoint-artifact-"
+            "dominated in BOTH implementations and is reported for "
+            "completeness, not used as a parity gate."
+        ),
+        "summary": summary,
+        "scenes": scenes,
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenes", type=int, default=60)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", type=str,
+                    default=str(REPO / "GEOMETRY_PARITY.json"))
+    args = ap.parse_args(argv)
+    result = run_corpus(args.scenes, args.seed)
+    Path(args.out).write_text(json.dumps(result, indent=1))
+    print(json.dumps({"n_scenes": result["n_scenes"],
+                      "summary": result["summary"]}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,12 @@ class GeometryConfig:
 
     num_bins: int = 50
     top_k_percent: float = 0.05
+    # Uniform pixel decimation before edge extraction: stride 2 quarters the
+    # dominant packed-key sort with curvature error quantified against the
+    # scipy oracle in GEOMETRY_PARITY.json (validity cutoffs scale by
+    # stride^2 to keep the reference decision boundary). 1 = reference-exact
+    # dense semantics.
+    stride: int = 1
     spline_degree: int = 3
     # Plays the role of FITPACK's s=0.1 but is a P-spline penalty weight, not
     # a residual target; 1e-3 calibrated against analytic arcs (tests/) to
@@ -137,8 +143,18 @@ class ServerConfig:
     calibration_path: str = "ml/configs/calibration_data.npz"
     metrics_csv: str = "logs/vision_service_metrics.csv"
     metrics_flush_every: int = 32
-    batch_window_ms: float = 0.0  # >0 enables cross-stream micro-batching
+    # Cross-stream micro-batching is OFF by default on purpose: measured on
+    # v5e, the U-Net forward's per-frame time RISES with batch (b1 0.86 ->
+    # b8 1.39 ms/frame; BENCH notes), so batch-1 chained dispatch is already
+    # peak aggregate throughput and batching only adds latency. Concurrent
+    # streams aggregate through the device queue instead. >0 enables the
+    # dispatcher for workloads where the tradeoff differs.
+    batch_window_ms: float = 0.0
     max_batch: int = 8  # per-dispatch cap when micro-batching
+    # Geometry decimation stride for serving (GeometryConfig.stride): 2
+    # quarters the edge-extraction sort with corpus-measured accuracy
+    # (GEOMETRY_PARITY.json: 2.8% mean truth error vs 3.3% at stride 1).
+    geometry_stride: int = 2
     # Model forward implementation: "auto" = Pallas-fused kernels on TPU,
     # Flax/XLA elsewhere; "flax" / "pallas" force one path (ops/pallas).
     model_forward: str = "auto"

@@ -33,7 +33,10 @@ def test_arc_scene_curvature_close_to_analytic():
     )
     assert bool(prof.valid)
     mean_k = float(prof.mean_curvature)
-    assert abs(mean_k - true_k) / true_k < 0.15, (mean_k, true_k)
+    # corpus-backed bound: GEOMETRY_PARITY.json stride1
+    # mean_curvature_vs_truth p90 = 3.7% over 60 randomized scenes; a clean
+    # noise-free arc sits well inside 6%
+    assert abs(mean_k - true_k) / true_k < 0.06, (mean_k, true_k)
 
 
 def test_matches_reference_oracle_on_arc():
@@ -45,8 +48,49 @@ def test_matches_reference_oracle_on_arc():
     assert bool(prof.valid) and om > 0
     ours_m = float(prof.mean_curvature)
     ours_x = float(prof.max_curvature)
-    assert abs(ours_m - om) / om < 0.2, (ours_m, om)
+    # corpus-backed: jax-vs-oracle divergence is dominated by FITPACK's own
+    # truth error (oracle 8.6% vs jax 3.3% mean truth error); p50 vs oracle
+    # is 5.6% and this clean scene sits near it. Max-curvature is endpoint-
+    # artifact-dominated in BOTH implementations (see GEOMETRY_PARITY.json
+    # notes), hence the looser bound.
+    assert abs(ours_m - om) / om < 0.12, (ours_m, om)
     assert abs(ours_x - ox) / max(ox, 1e-9) < 0.5, (ours_x, ox)
+
+
+def test_parity_corpus_sample():
+    """A 12-scene sample of the randomized parity corpus
+    (tools/geometry_parity.py writes the full 60-scene distribution to
+    GEOMETRY_PARITY.json): the jax engine must track analytic truth within
+    the corpus-measured envelope at both stride 1 (reference-exact) and
+    stride 2 (the serving fast path)."""
+    from robotic_discovery_platform_tpu.tools.geometry_parity import (
+        random_scene,
+    )
+    from robotic_discovery_platform_tpu.utils.config import GeometryConfig
+
+    rng = np.random.default_rng(7)
+    fns = {s: geometry.make_jitted_profile(GeometryConfig(stride=s))
+           for s in (1, 2)}
+    errs = {1: [], 2: []}
+    n = 0
+    while n < 12:
+        mask, depth, k, scale, true_k, _ = random_scene(rng)
+        om, _, _ = oracle_curvature(mask, depth, k, scale)
+        if om == 0.0:
+            continue
+        n += 1
+        for s, fn in fns.items():
+            p = fn(jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k),
+                   scale)
+            assert bool(p.valid)
+            errs[s].append(abs(float(p.mean_curvature) - true_k) / true_k)
+    for s in (1, 2):
+        e = np.asarray(errs[s])
+        # corpus p90 is 3.7% (stride1) / 4.6% (stride2); allow headroom on
+        # the small sample, and a 10% hard cap per scene except the known
+        # thin-band tail (corpus max 29%)
+        assert np.percentile(e, 75) < 0.08, (s, e)
+        assert np.median(e) < 0.05, (s, e)
 
 
 def test_empty_mask_graceful_zero():

@@ -53,6 +53,22 @@ def test_fused_analyzer_perfect_mask_recovers_curvature():
     )
 
 
+def test_preprocess_matches_jax_image_resize():
+    """The separable matmul preprocess must be numerically identical to the
+    jax.image.resize antialiased bilinear path it replaces (same weights,
+    highest-precision contraction) -- the torchvision-parity guarantees in
+    test_torch_parity.py flow through this."""
+    rng = np.random.default_rng(0)
+    for shape, size in (((2, 480, 640, 3), 256), ((1, 128, 96, 3), 256)):
+        f = rng.integers(0, 255, shape, np.uint8)
+        ref = jax.image.resize(
+            jnp.asarray(f, jnp.float32) / 255.0,
+            (shape[0], size, size, 3), "bilinear", antialias=True,
+        )
+        got = pipeline.preprocess(jnp.asarray(f), size)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-6)
+
+
 def test_batch_analyzer_matches_single():
     model, variables = _small_model_and_vars()
     mask, depth, k, scale, _ = make_arc_scene(h=120, w=160, r_px=70.0, band_px=30)
