@@ -60,9 +60,18 @@ def _roundtrip_ms() -> float:
 
 
 def _measure_chain(chained, f0, chain: int, rt_ms: float, reps: int = 3):
-    """Best-of-reps per-iteration ms for one compiled chain + one fetch."""
+    """Best-of-reps per-iteration ms for one compiled chain + one fetch.
+    The first call (compile) retries: the tunneled compile service on this
+    image intermittently drops connections (HTTP 500 / truncated body)."""
     t0 = time.perf_counter()
-    np.asarray(chained(f0))
+    for attempt in range(4):
+        try:
+            np.asarray(chained(f0))
+            break
+        except Exception:
+            if attempt == 3:
+                raise
+            time.sleep(5)
     compile_s = time.perf_counter() - t0
     best = float("inf")
     for _ in range(reps):

@@ -194,7 +194,16 @@ def compute_curvature_profile(
     cx, cy = intrinsics[0, 2], intrinsics[1, 2]
 
     s = max(1, int(cfg.stride))
+    native_cloud_count = None
     if s > 1:
+        # Exact native-resolution cloud count for the validity gate: a
+        # pooled cell survives whether 1 or s^2 of its pixels were valid,
+        # so scaling the POOLED count by s^2 would let a sparse speckle
+        # mask (e.g. 30 isolated pixels) pass the reference's
+        # min_cloud_points=100 cutoff. One elementwise reduction, no sort.
+        native_cloud_count = jnp.sum(
+            (mask > 0) & (jnp.asarray(depth) > 0)
+        ).astype(jnp.int32)
         # Decimate the cloud before the (dominant) packed-key sort: stride 2
         # quarters the sorted element count. Implemented as an s x s
         # max-pool of the MASKED depth -- NOT a strided slice, which costs
@@ -238,11 +247,16 @@ def compute_curvature_profile(
     mean_k = jnp.where(n_kv > 0, jnp.sum(kappa) / jnp.maximum(n_kv, 1), 0.0)
     max_k = jnp.max(jnp.where(k_valid, kappa, 0.0))
 
-    # A strided view sees ~1/s^2 of the native points, so the reference's
-    # native-resolution validity cutoffs (:64-70) scale by s^2 to keep the
-    # same valid/invalid decision boundary.
+    # Validity gates keep the reference's native-resolution cutoffs
+    # (:64-70): the cloud gate uses the EXACT native count (computed above
+    # when striding); the edge gate scales the pooled selection by s^2 --
+    # an estimate that is exact for dense masks and conservative-ish for
+    # sparse ones (the exact cloud gate already rejects speckle frames).
+    gate_cloud = (
+        native_cloud_count if native_cloud_count is not None else cloud_count
+    )
     ok = (
-        (cloud_count * (s * s) >= cfg.min_cloud_points)
+        (gate_cloud >= cfg.min_cloud_points)
         & binnable
         & (edge_count * (s * s) >= cfg.min_edge_points)
         & (n_kv > 0)

@@ -27,8 +27,18 @@ from flax import linen as nn
 from robotic_discovery_platform_tpu.models.unet import upsample_align_corners
 from robotic_discovery_platform_tpu.ops.pallas import conv as pconv
 
-# Measured v5e crossover (see tests/test_pallas.py bench + BENCH notes):
-# pallas <= threshold < xla.
+# Measured v5e crossover: pallas <= threshold < xla. At batch 1 every
+# layer of the deployed 256^2 forward sits under the budget, so the whole
+# net runs Pallas-uniform; larger batches push the wide feature maps over
+# it (batched wide-map Pallas launches also overflow VMEM outright).
+#
+# Why not per-shape dispatch: PALLASBENCH.json's isolated-launch timings
+# show 3 of 16 conv shapes losing to XLA (0.48-0.64x), but rerouting just
+# those to XLA was measured 24% SLOWER end-to-end in the fused serving
+# graph (interleaved A/B: 472 vs 584 FPS) -- every pallas<->XLA boundary
+# pays a layout transition that outweighs the per-launch loss. The
+# dispatcher therefore optimizes the composed pipeline, not individual
+# launches; treat PALLASBENCH's per-shape rows as launch-level data only.
 PALLAS_MAX_ELEMS = 2 ** 23
 
 

@@ -69,6 +69,54 @@ def _tree_get(tree: dict, path: tuple):
     return node
 
 
+def _stage_of_path(path: tuple) -> str:
+    """Reference module attribute name for a Flax slot path (the top-level
+    names of reference pkg/segmentation_model.py:97-120)."""
+    head = path[0]
+    if head == "DoubleConv_0":
+        return "inc"
+    if head.startswith("Down_"):
+        return f"down{int(head.split('_')[1]) + 1}"
+    if head.startswith("Up_"):
+        return f"up{int(head.split('_')[1]) + 1}"
+    return "outc"
+
+
+_REFERENCE_STAGES = frozenset(
+    ["inc", "outc"]
+    + [f"down{i}" for i in range(1, 5)]
+    + [f"up{i}" for i in range(1, 5)]
+)
+
+
+def _make_stage_check(tensor_names) -> "callable":
+    """Structural order is robust to renames but blind to same-shaped slot
+    swaps; when the checkpoint uses the reference's module names, cross-check
+    each tensor's stage token against the slot it lands in. Checkpoints with
+    foreign naming skip the check (with a log line) rather than failing."""
+    tops = {n.split(".", 1)[0] for n in tensor_names}
+    if not tops <= _REFERENCE_STAGES:
+        log.info(
+            "state_dict does not use reference module names (%s); "
+            "name/slot cross-check disabled, trusting structural order",
+            sorted(tops - _REFERENCE_STAGES)[:3],
+        )
+        return lambda name, path: None
+
+    def check_stage(name: str, path: tuple) -> None:
+        want = _stage_of_path(path)
+        got = name.split(".", 1)[0]
+        if got != want:
+            raise ValueError(
+                f"tensor {name!r} is about to be mapped into stage "
+                f"{want!r} -- structural order and checkpoint names "
+                "disagree (reordered or architecture-mismatched "
+                "state_dict)"
+            )
+
+    return check_stage
+
+
 def convert_state_dict(state_dict: dict, cfg: ModelConfig = ModelConfig()):
     """torch ``state_dict`` (name -> tensor/ndarray) -> Flax variables.
 
@@ -111,10 +159,14 @@ def convert_state_dict(state_dict: dict, cfg: ModelConfig = ModelConfig()):
                 f"{tuple(got.shape)}, model expects {tuple(want_shape)}"
             )
 
+    check_stage = _make_stage_check([n for n, _ in tensors])
+
     for path, kind in _flax_slot_order(cfg):
         if kind in ("conv", "head"):
             n_tensors = 1 if kind == "conv" else 2  # head conv has a bias
             got = take(n_tensors)
+            for tname, _ in got:
+                check_stage(tname, path)
             name, w = got[0]
             target = _tree_get(params, path)
             hwio = w.transpose(2, 3, 1, 0)  # OIHW -> HWIO
@@ -126,6 +178,8 @@ def convert_state_dict(state_dict: dict, cfg: ModelConfig = ModelConfig()):
                 target["bias"] = b.astype(target["bias"].dtype)
         elif kind == "convt":
             (name, w), (bname, b) = take(2)
+            check_stage(name, path)
+            check_stage(bname, path)
             target = _tree_get(params, path)
             # torch ConvTranspose2d weight is [Cin, Cout, kH, kW]; Flax's
             # nn.ConvTranspose places the kernel spatially FLIPPED relative
@@ -140,6 +194,8 @@ def convert_state_dict(state_dict: dict, cfg: ModelConfig = ModelConfig()):
             target["bias"] = b.astype(target["bias"].dtype)
         else:  # bn: weight, bias, running_mean, running_var
             (wn, w), (bn_, b), (mn, m), (vn, v) = take(4)
+            for tname in (wn, bn_, mn, vn):
+                check_stage(tname, path)
             p_target = _tree_get(params, path)
             s_target = _tree_get(stats, path)
             check(wn, w, p_target["scale"].shape, path)

@@ -49,9 +49,22 @@ class MlflowStore:
     """FileStore-protocol adapter over a real MLflow client."""
 
     def __init__(self, uri: str):
+        import shutil
+        import weakref
+
         self.uri = uri
         self.client = MlflowClient(tracking_uri=uri, registry_uri=uri)
         self._scratch = Path(tempfile.mkdtemp(prefix="rdp-mlflow-artifacts-"))
+        # long-lived processes (serving, repeated runs) must not accumulate
+        # model-sized staging directories in /tmp: reclaim on GC/interpreter
+        # exit, or explicitly via close()
+        self._cleanup = weakref.finalize(
+            self, shutil.rmtree, str(self._scratch), True
+        )
+
+    def close(self) -> None:
+        """Remove the artifact staging scratch directory."""
+        self._cleanup()
 
     # -- experiments / runs -------------------------------------------------
 

@@ -103,6 +103,21 @@ def run_supervised(
             payload = json.loads(result_path.read_text())
             return SupervisedResult(restarts=restarts, **payload)
         restarts += 1
+        # Fail fast on pre-training errors: a child that raises a clean
+        # Python exception (rc == 1: bad dataset path, invalid config,
+        # import error) without EVER writing a checkpoint is deterministic
+        # -- retrying would pay full process bring-up max_restarts times
+        # before surfacing the same error. Signal deaths (rc >= 128 or
+        # negative: SIGKILL preemption, OOM kill, SIGTERM) and the injected
+        # fault stay retryable even before the first checkpoint.
+        ckpt_root = Path(cfg.checkpoint_dir)
+        has_any_checkpoint = ckpt_root.is_dir() and any(ckpt_root.iterdir())
+        died_by_signal = rc < 0 or rc >= 128 or rc == _FAULT_EXIT
+        if not has_any_checkpoint and not died_by_signal:
+            raise RuntimeError(
+                f"training child failed before its first checkpoint "
+                f"(rc={rc}); treating as a non-retryable startup error"
+            )
         if restarts > max_restarts:
             raise RuntimeError(
                 f"training failed {restarts} times (last rc={rc}); "

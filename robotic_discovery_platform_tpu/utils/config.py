@@ -69,6 +69,9 @@ class TrainConfig:
     dataset_dir: str = "ml/datasets/processed"
     checkpoint_dir: str = "ml/checkpoints"
     keep_checkpoints: int = 3
+    # checkpoint every N epochs (the final epoch always saves); raise for
+    # short-epoch runs where per-epoch state serialization dominates
+    checkpoint_every: int = 1
     # TPU-first:
     donate_state: bool = True
     log_every: int = 1

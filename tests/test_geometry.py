@@ -117,6 +117,25 @@ def test_tiny_mask_graceful_zero():
     assert not bool(prof.valid)
 
 
+def test_speckle_mask_invalid_at_stride_2():
+    """A sparse speckle mask (isolated pixels in distinct pooled cells)
+    must stay below min_cloud_points at stride 2 exactly as at stride 1:
+    the gate counts NATIVE valid pixels, not pooled-cells x stride^2."""
+    rng = np.random.default_rng(3)
+    mask = np.zeros((480, 640), np.uint8)
+    vv = rng.integers(0, 240, 60) * 2
+    uu = rng.integers(0, 320, 60) * 2
+    mask[vv, uu] = 1  # <= 60 isolated pixels, each its own 2x2 cell
+    depth = np.full((480, 640), 500, np.uint16)
+    k = np.array([[600.0, 0, 320], [0, 600.0, 240], [0, 0, 1]])
+    for s in (1, 2):
+        prof = geometry.compute_curvature_profile(
+            jnp.asarray(mask), jnp.asarray(depth), jnp.asarray(k), 0.001,
+            GeometryConfig(stride=s),
+        )
+        assert not bool(prof.valid), s
+
+
 def test_zero_depth_excluded():
     mask, depth, k, scale, _ = make_arc_scene()
     depth2 = depth.copy()

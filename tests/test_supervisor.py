@@ -53,7 +53,26 @@ def test_preemption_mid_run_resumes_and_completes(tmp_path):
 
 
 @pytest.mark.slow
-def test_unrecoverable_failure_raises(tmp_path):
+def test_startup_failure_fails_fast_without_retries(tmp_path):
+    """A child that raises a clean exception before EVER checkpointing (bad
+    dataset path) is a deterministic startup error: the supervisor must
+    surface it after ONE attempt instead of paying max_restarts full
+    process bring-ups. (Signal deaths -- preemption, OOM kill -- stay
+    retryable even before the first checkpoint.)"""
     cfg = disk_cfg(tmp_path, dataset_dir=str(tmp_path / "missing"))
+    with pytest.raises(RuntimeError, match="before its first checkpoint"):
+        supervisor.run_supervised(cfg, TINY_MODEL, max_restarts=5)
+
+
+@pytest.mark.slow
+def test_retry_exhaustion_raises(tmp_path):
+    """With a checkpoint present (training demonstrably started), repeated
+    child deaths must burn through max_restarts and surface the exhaustion
+    error -- the retry-counting branch the fail-fast path must not
+    shadow."""
+    cfg = disk_cfg(tmp_path, dataset_dir=str(tmp_path / "missing"))
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "0").mkdir()  # simulate a prior epoch's checkpoint
     with pytest.raises(RuntimeError, match="training failed"):
         supervisor.run_supervised(cfg, TINY_MODEL, max_restarts=1)
