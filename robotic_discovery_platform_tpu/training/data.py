@@ -14,6 +14,13 @@ INTER_NEAREST for masks to ``img_size``, /255 normalization, deterministic
   pipeline").
 - **Sharding-aware batching**: ``Batches`` can pad/trim to a global batch
   divisible by the data-parallel world size.
+- **Full final batch**: jit needs static shapes, so a ragged last batch is
+  filled by cyclically repeating the epoch's permutation (``epoch_order``)
+  -- a handful of samples are seen twice per epoch. The reference instead
+  yields a short ragged batch (torch DataLoader default); at the reference
+  config (51 train images, batch 4) the difference is one duplicated
+  sample per epoch, and measured convergence parity is unaffected
+  (TRAINBENCH*.json).
 """
 
 from __future__ import annotations

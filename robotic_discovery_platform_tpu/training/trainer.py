@@ -202,6 +202,9 @@ class TrainResult:
     final_metrics: dict
     epochs_run: int
     wall_clock_s: float
+    # per-epoch wall seconds (train + val, excluding checkpoint IO) so
+    # benchmarks can separate steady-state rate from host contention
+    epoch_seconds: list = None
 
 
 def train_model(
@@ -443,6 +446,7 @@ def train_model(
                 }
             )
 
+        epoch_seconds: list = []
         start_epoch = min(int(state.epoch), cfg.epochs)
         if int(state.epoch) >= cfg.epochs:
             log.warning(
@@ -475,10 +479,11 @@ def train_model(
                 tracking.log_metric("val_loss", val["loss"], step=epoch)
                 tracking.log_metric("val_miou", val["miou"], step=epoch)
                 tracking.log_metric("val_dice", val["dice"], step=epoch)
+            epoch_seconds.append(time.time() - t_epoch)
             log.info(
                 "epoch %d/%d train_loss=%.4f val_loss=%.4f miou=%.4f (%.1fs)",
                 epoch + 1, cfg.epochs, train_loss, val["loss"], val["miou"],
-                time.time() - t_epoch,
+                epoch_seconds[-1],
             )
 
             if val["loss"] < float(state.best_val_loss):
@@ -544,4 +549,5 @@ def train_model(
         final_metrics=final_metrics,
         epochs_run=cfg.epochs - start_epoch,
         wall_clock_s=time.time() - t_start,
+        epoch_seconds=epoch_seconds,
     )

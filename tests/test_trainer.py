@@ -70,6 +70,20 @@ def test_resume_from_checkpoint(tmp_path, arrays):
     assert res.epochs_run == 2  # 3 total - 1 already done
 
 
+def test_checkpoint_every_skips_intermediate_saves(tmp_path, arrays):
+    """checkpoint_every=2 over 5 epochs saves steps {2, 4, 5}: every second
+    epoch plus the final epoch unconditionally."""
+    from pathlib import Path
+
+    cfg = tiny_cfg(tmp_path, epochs=5, checkpoint_every=2)
+    trainer.train_model(cfg, TINY_MODEL, arrays=arrays, register=False)
+    steps = sorted(
+        int(p.name) for p in Path(cfg.checkpoint_dir).iterdir()
+        if p.name.isdigit()
+    )
+    assert steps == [2, 4, 5], steps
+
+
 def test_dice_loss_variant(tmp_path, arrays):
     cfg = tiny_cfg(tmp_path, loss="bce_dice")
     res = trainer.train_model(cfg, TINY_MODEL, arrays=arrays, register=False)
