@@ -73,6 +73,40 @@ def upsample_align_corners(x, h: int, w: int):
     return y.astype(x.dtype)
 
 
+def _kernel_init(init: str):
+    """Conv kernel initializer family.
+
+    ``"torch"`` reproduces torch ``Conv2d``'s default
+    ``kaiming_uniform_(a=sqrt(5))`` (reference models are built with it:
+    pkg/segmentation_model.py:30-33 uses plain ``nn.Conv2d``): gain
+    ``sqrt(2/(1+5)) = sqrt(1/3)`` over fan_in with a uniform distribution,
+    i.e. ``U(+-sqrt(1/fan_in))`` -- exactly
+    ``variance_scaling(1/3, "fan_in", "uniform")``. Matching the init
+    family makes seed-for-seed training comparisons against the torch
+    anchor fair (round-3 verdict item 1). ``"lecun"`` is the Flax default.
+    """
+    if init == "torch":
+        return nn.initializers.variance_scaling(
+            1.0 / 3.0, "fan_in", "uniform"
+        )
+    if init == "lecun":
+        return nn.initializers.lecun_normal()
+    raise ValueError(f"unknown init {init!r}")
+
+
+def _bias_init(init: str, fan_in: int):
+    """torch ``Conv2d`` bias default is ``U(+-1/sqrt(fan_in))``; Flax's is
+    zeros. fan_in is known statically at call time (in_features * kh * kw)."""
+    if init != "torch":
+        return nn.initializers.zeros_init()
+    bound = 1.0 / float(np.sqrt(fan_in))
+
+    def initializer(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return initializer
+
+
 def _norm(norm: str, dtype: DType, train: bool, features: int):
     if norm == "batch":
         # momentum 0.9 matches the reference's torch BatchNorm2d default
@@ -97,14 +131,18 @@ class DoubleConv(nn.Module):
     mid_features: int | None = None
     norm: str = "batch"
     dtype: DType = jnp.bfloat16
+    weight_init: str = "torch"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         mid = self.mid_features or self.features
-        x = nn.Conv(mid, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        kinit = _kernel_init(self.weight_init)
+        x = nn.Conv(mid, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, kernel_init=kinit)(x)
         x = _norm(self.norm, self.dtype, train, mid)(x)
         x = nn.relu(x)
-        x = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, kernel_init=kinit)(x)
         x = _norm(self.norm, self.dtype, train, self.features)(x)
         return nn.relu(x)
 
@@ -115,11 +153,13 @@ class Down(nn.Module):
     features: int
     norm: str = "batch"
     dtype: DType = jnp.bfloat16
+    weight_init: str = "torch"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        return DoubleConv(self.features, norm=self.norm, dtype=self.dtype)(x, train)
+        return DoubleConv(self.features, norm=self.norm, dtype=self.dtype,
+                          weight_init=self.weight_init)(x, train)
 
 
 class Up(nn.Module):
@@ -135,6 +175,7 @@ class Up(nn.Module):
     bilinear: bool = True
     norm: str = "batch"
     dtype: DType = jnp.bfloat16
+    weight_init: str = "torch"
 
     @nn.compact
     def __call__(self, x, skip, train: bool = False):
@@ -145,11 +186,27 @@ class Up(nn.Module):
             mid = (x.shape[3] + c) // 2
             x = jnp.concatenate([skip, x.astype(skip.dtype)], axis=-1)
             return DoubleConv(self.features, mid_features=mid,
-                              norm=self.norm, dtype=self.dtype)(x, train)
-        x = nn.ConvTranspose(x.shape[3] // 2, (2, 2), strides=(2, 2), dtype=self.dtype)(x)
+                              norm=self.norm, dtype=self.dtype,
+                              weight_init=self.weight_init)(x, train)
+        in_ch = x.shape[3]
+        # torch ConvTranspose2d computes init fan_in over weight dim 1
+        # (out_channels) * kh * kw = (in_ch // 2) * 4 -- for BOTH kernel
+        # and bias. variance_scaling's "fan_in" would use in_ch * kh * kw
+        # (Flax ConvTranspose kernels are (kh, kw, in, out)), a bound
+        # sqrt(2) too small here, so the kernel uses the same explicit
+        # U(+-1/sqrt(fan)) closure as the bias.
+        tfan = (in_ch // 2) * 4
+        x = nn.ConvTranspose(
+            in_ch // 2, (2, 2), strides=(2, 2), dtype=self.dtype,
+            kernel_init=(_bias_init("torch", tfan)
+                         if self.weight_init == "torch"
+                         else _kernel_init(self.weight_init)),
+            bias_init=_bias_init(self.weight_init, tfan),
+        )(x)
         x = jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="nearest")
         x = jnp.concatenate([skip, x.astype(skip.dtype)], axis=-1)
-        return DoubleConv(self.features, norm=self.norm, dtype=self.dtype)(x, train)
+        return DoubleConv(self.features, norm=self.norm, dtype=self.dtype,
+                          weight_init=self.weight_init)(x, train)
 
 
 class UNet(nn.Module):
@@ -164,22 +221,30 @@ class UNet(nn.Module):
     norm: str = "batch"
     dtype: DType = jnp.bfloat16
     in_features: int = 3  # used by init helpers; convs infer from input
+    weight_init: str = "torch"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         f = self.base_features
         factor = 2 if self.bilinear else 1
         x = x.astype(self.dtype)
-        x1 = DoubleConv(f, norm=self.norm, dtype=self.dtype)(x, train)
-        x2 = Down(f * 2, norm=self.norm, dtype=self.dtype)(x1, train)
-        x3 = Down(f * 4, norm=self.norm, dtype=self.dtype)(x2, train)
-        x4 = Down(f * 8, norm=self.norm, dtype=self.dtype)(x3, train)
-        x5 = Down(f * 16 // factor, norm=self.norm, dtype=self.dtype)(x4, train)
-        y = Up(f * 8 // factor, self.bilinear, self.norm, self.dtype)(x5, x4, train)
-        y = Up(f * 4 // factor, self.bilinear, self.norm, self.dtype)(y, x3, train)
-        y = Up(f * 2 // factor, self.bilinear, self.norm, self.dtype)(y, x2, train)
-        y = Up(f, self.bilinear, self.norm, self.dtype)(y, x1, train)
-        logits = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype)(y)
+        kw = dict(norm=self.norm, dtype=self.dtype, weight_init=self.weight_init)
+        x1 = DoubleConv(f, **kw)(x, train)
+        x2 = Down(f * 2, **kw)(x1, train)
+        x3 = Down(f * 4, **kw)(x2, train)
+        x4 = Down(f * 8, **kw)(x3, train)
+        x5 = Down(f * 16 // factor, **kw)(x4, train)
+        y = Up(f * 8 // factor, self.bilinear, **kw)(x5, x4, train)
+        y = Up(f * 4 // factor, self.bilinear, **kw)(y, x3, train)
+        y = Up(f * 2 // factor, self.bilinear, **kw)(y, x2, train)
+        y = Up(f, self.bilinear, **kw)(y, x1, train)
+        # 1x1 head: the only conv with a bias (reference OutConv,
+        # pkg/segmentation_model.py:78-84); fan_in = in_features * 1 * 1
+        logits = nn.Conv(
+            self.num_classes, (1, 1), dtype=self.dtype,
+            kernel_init=_kernel_init(self.weight_init),
+            bias_init=_bias_init(self.weight_init, y.shape[-1]),
+        )(y)
         return logits.astype(jnp.float32)
 
 
@@ -191,6 +256,7 @@ def build_unet(cfg: ModelConfig = ModelConfig()) -> UNet:
         norm=cfg.norm,
         dtype=jnp.dtype(cfg.compute_dtype),
         in_features=cfg.in_channels,
+        weight_init=cfg.init,
     )
 
 

@@ -122,9 +122,17 @@ def _edge_points(x, y, z, valid, cfg: GeometryConfig):
     y_min = jnp.min(jnp.where(v, ys, big))
     y_max = jnp.max(jnp.where(v, ys, -big))
     q_scale = ((1 << 25) - 1) / jnp.maximum(y_max - y_min, 1e-12)
+    # Clip in FLOAT before the int cast: for a degenerate flat scene
+    # (y_max ~ y_min) q_scale ~ 3.4e19 and the product overflows int32,
+    # whose out-of-range convert is implementation-defined (saturates on
+    # TPU, may wrap elsewhere) -- clipping first keeps tie ordering
+    # backend-independent. The float bound must be exactly representable
+    # AND <= 2^25-1: 2^25-1 itself rounds UP to 2^25 in float32 (ulp is 2
+    # there), which would bleed the min-y point's key into the next bin's
+    # range; 2^25-2 is representable, so the cast result stays < 2^25.
     qy = jnp.clip(
-        ((y_max - ys) * q_scale).astype(jnp.int32), 0, (1 << 25) - 1
-    )
+        (y_max - ys) * q_scale, 0.0, float((1 << 25) - 2)
+    ).astype(jnp.int32)
     key = jnp.where(
         v, bin_idx * shift + qy, jnp.int32(cfg.num_bins) * shift
     )

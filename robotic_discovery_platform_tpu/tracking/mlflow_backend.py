@@ -49,11 +49,14 @@ class MlflowStore:
     """FileStore-protocol adapter over a real MLflow client."""
 
     def __init__(self, uri: str):
+        self.uri = uri
+        self.client = MlflowClient(tracking_uri=uri, registry_uri=uri)
+        self._make_scratch()
+
+    def _make_scratch(self) -> None:
         import shutil
         import weakref
 
-        self.uri = uri
-        self.client = MlflowClient(tracking_uri=uri, registry_uri=uri)
         self._scratch = Path(tempfile.mkdtemp(prefix="rdp-mlflow-artifacts-"))
         # long-lived processes (serving, repeated runs) must not accumulate
         # model-sized staging directories in /tmp: reclaim on GC/interpreter
@@ -62,8 +65,19 @@ class MlflowStore:
             self, shutil.rmtree, str(self._scratch), True
         )
 
+    def _ensure_scratch(self) -> Path:
+        # The store stays usable after close(): artifact-staging methods
+        # lazily recreate the scratch dir (with a fresh finalizer -- the
+        # old one is one-shot, so a bare mkdir would leak the new dir and,
+        # worse, a post-close log_model would die mid-way on the missing
+        # staging path).
+        if not self._scratch.exists():
+            self._make_scratch()
+        return self._scratch
+
     def close(self) -> None:
-        """Remove the artifact staging scratch directory."""
+        """Remove the artifact staging scratch directory. The store remains
+        usable; a later staging operation recreates scratch lazily."""
         self._cleanup()
 
     # -- experiments / runs -------------------------------------------------
@@ -119,7 +133,7 @@ class MlflowStore:
 
     def artifact_dir(self, run_id: str) -> Path:
         """Local staging dir; finalized by ``publish_artifacts``."""
-        d = self._scratch / run_id
+        d = self._ensure_scratch() / run_id
         d.mkdir(parents=True, exist_ok=True)
         return d
 
@@ -181,7 +195,7 @@ class MlflowStore:
 
     def version_path(self, name: str, version: int) -> Path:
         """Download the registry version's model artifacts to a local dir."""
-        dest = self._scratch / "downloads" / name / str(version)
+        dest = self._ensure_scratch() / "downloads" / name / str(version)
         dest.mkdir(parents=True, exist_ok=True)
         source = self.client.get_model_version(name, str(version)).source
         local = mlflow.artifacts.download_artifacts(

@@ -89,6 +89,7 @@ def run_supervised(
     spec_path.write_text(json.dumps(spec))
 
     restarts = 0
+    clean_failures = 0  # CONSECUTIVE rc=1-style exits; reset by signal death
     while True:
         rc = subprocess.call(
             [sys.executable, "-m",
@@ -105,17 +106,29 @@ def run_supervised(
         restarts += 1
         # Fail fast on pre-training errors: a child that raises a clean
         # Python exception (rc == 1: bad dataset path, invalid config,
-        # import error) without EVER writing a checkpoint is deterministic
-        # -- retrying would pay full process bring-up max_restarts times
-        # before surfacing the same error. Signal deaths (rc >= 128 or
-        # negative: SIGKILL preemption, OOM kill, SIGTERM) and the injected
-        # fault stay retryable even before the first checkpoint.
-        ckpt_root = Path(cfg.checkpoint_dir)
-        has_any_checkpoint = ckpt_root.is_dir() and any(ckpt_root.iterdir())
+        # import error) without a COMPLETED checkpoint is almost certainly
+        # deterministic -- retrying would pay full process bring-up
+        # max_restarts times before surfacing the same error. Two
+        # refinements over a bare "anything in checkpoint_dir" test:
+        # - only a finalized orbax step counts as "training started"
+        #   (digit-named step dir); stale tmp dirs from an interrupted save
+        #   don't make a deterministic startup error burn all restarts.
+        # - one clean-exit retry IS allowed first, because a transient
+        #   failure in the pre-first-checkpoint window (flaky shared FS,
+        #   tracking backend, MemoryError) also exits rc=1; only a SECOND
+        #   consecutive clean failure with still no checkpoint is declared
+        #   non-retryable.
+        # Signal deaths (rc >= 128 or negative: SIGKILL preemption, OOM
+        # kill, SIGTERM) and the injected fault always stay retryable, and
+        # RESET the consecutive-clean-failure count -- a preemption
+        # followed by one transient clean failure is not a deterministic
+        # startup error.
+        has_completed_step = _has_completed_step(Path(cfg.checkpoint_dir))
         died_by_signal = rc < 0 or rc >= 128 or rc == _FAULT_EXIT
-        if not has_any_checkpoint and not died_by_signal:
+        clean_failures = 0 if died_by_signal else clean_failures + 1
+        if not has_completed_step and clean_failures >= 2:
             raise RuntimeError(
-                f"training child failed before its first checkpoint "
+                f"training child failed twice before its first checkpoint "
                 f"(rc={rc}); treating as a non-retryable startup error"
             )
         if restarts > max_restarts:
@@ -133,6 +146,16 @@ def run_supervised(
 # exit code the injected fault uses; distinct from real crash codes so logs
 # are unambiguous
 _FAULT_EXIT = 113
+
+
+def _has_completed_step(ckpt_root: Path) -> bool:
+    """True iff a FINALIZED orbax step exists: orbax writes into
+    ``<step>.orbax-checkpoint-tmp-*`` and renames to the bare digit-named
+    dir only on completion, so pure-digit entries are exactly the durable
+    steps (the same test ``_arm_fault`` uses)."""
+    if not ckpt_root.is_dir():
+        return False
+    return any(p.name.isdigit() for p in ckpt_root.iterdir())
 
 
 def _arm_fault(fault: dict, checkpoint_dir: str) -> None:

@@ -237,12 +237,25 @@ def train_model(
 
     if arrays is not None:
         xs, ys = arrays
-        # normalize to ndarrays once: the index-array batching below needs
-        # fancy indexing (free for inputs that are already numpy/jax arrays)
+        # normalize to ndarrays once (dtype preserved, so integer inputs
+        # are normalized identically whether they arrive as arrays or
+        # lists): the index-array batching below needs fancy indexing
         if not hasattr(xs, "nbytes"):
-            xs = np.asarray(xs, np.float32)
+            xs = np.asarray(xs)
         if not hasattr(ys, "nbytes"):
-            ys = np.asarray(ys, np.float32)
+            ys = np.asarray(ys)
+        # Integer inputs get the same float normalization the file loader
+        # applies (data.PairedSegmentationData.load): images /255, masks
+        # /255 when 0/255-coded but a plain cast when already {0, 1} class
+        # indices -- dividing those by 255 would silently train against
+        # ~0.004 targets. Besides the wrong scale, u8 arrays reaching the
+        # jitted train step trip an XLA CPU space_to_batch crash on conv
+        # backprop (e.g. synthetic.generate_arrays' raw uint8 output).
+        if not np.issubdtype(xs.dtype, np.floating):
+            xs = np.asarray(xs, np.float32) / 255.0
+        if not np.issubdtype(ys.dtype, np.floating):
+            scale = 255.0 if np.max(ys, initial=0) > 1 else 1.0
+            ys = np.asarray(ys, np.float32) / scale
         n_samples = len(xs)
         ds = None
     else:
@@ -273,6 +286,12 @@ def train_model(
     if cfg.epoch_mode not in ("auto", "scan", "stream"):
         raise ValueError(
             f"epoch_mode must be auto|scan|stream, got {cfg.epoch_mode!r}"
+        )
+    if cfg.checkpoint_every < 1:
+        # 0 would be a ZeroDivisionError deep in the epoch loop; negatives
+        # would silently save every epoch
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {cfg.checkpoint_every}"
         )
     def _nbytes(a) -> int:
         # no np.asarray here: that would copy (or device-fetch) the whole

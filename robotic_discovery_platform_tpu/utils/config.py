@@ -45,6 +45,11 @@ class ModelConfig:
     # TPU-first knobs (no reference equivalent):
     compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
     norm: str = "batch"  # "batch" matches reference; "group" is jit-friendlier
+    # Weight-init family: "torch" reproduces torch Conv2d's default
+    # kaiming_uniform_(a=sqrt(5)) so seed-for-seed comparisons against the
+    # reference anchor are init-fair (models/unet._kernel_init); "lecun" is
+    # the Flax default family.
+    init: str = "torch"
 
 
 @dataclass(frozen=True)
@@ -154,10 +159,18 @@ class ServerConfig:
     # dispatcher for workloads where the tradeoff differs.
     batch_window_ms: float = 0.0
     max_batch: int = 8  # per-dispatch cap when micro-batching
-    # Geometry decimation stride for serving (GeometryConfig.stride): 2
-    # quarters the edge-extraction sort with corpus-measured accuracy
-    # (GEOMETRY_PARITY.json: 2.8% mean truth error vs 3.3% at stride 1).
-    geometry_stride: int = 2
+    # Geometry decimation stride (GeometryConfig.stride). 1 = reference-
+    # exact dense semantics, the DEFAULT: serving numerics match the
+    # reference out of the box. 2 is the opt-in fast profile -- it quarters
+    # the edge-extraction sort (~8% more FPS, BENCH r03: 544 vs 504) with
+    # corpus-measured curvature accuracy (GEOMETRY_PARITY.json: 2.8% mean
+    # truth error vs 3.3% at stride 1) BUT approximate validity gates:
+    # near the thresholds the edge gate (edge_count * s^2 >=
+    # min_edge_points) can ACCEPT frames the reference would reject, and
+    # the pooled binnable gate (pooled n_valid >= num_bins) can REJECT
+    # frames the reference accepts (e.g. 150 native points spread over
+    # <50 pooled cells).
+    geometry_stride: int = 1
     # Model forward implementation: "auto" = Pallas-fused kernels on TPU,
     # Flax/XLA elsewhere; "flax" / "pallas" force one path (ops/pallas).
     model_forward: str = "auto"

@@ -265,3 +265,20 @@ def test_mlflow_store_scratch_cleanup(mlflow_store):
     assert scratch.exists()
     mlflow_store.close()
     assert not scratch.exists()
+
+
+def test_mlflow_store_usable_after_close(mlflow_store):
+    """close() must not brick the store: a later artifact-staging call
+    lazily recreates scratch (with a fresh finalizer) instead of dying on
+    the deleted path (round-3 advice)."""
+    mlflow_store.close()
+    exp = mlflow_store.get_or_create_experiment("post-close")
+    run = mlflow_store.create_run(exp)
+    d = mlflow_store.artifact_dir(run)
+    assert d.exists()
+    (d / "weights.bin").write_bytes(b"x")
+    mlflow_store.publish_artifacts(run, d)
+    # and the NEW scratch is cleaned by the re-armed finalizer
+    scratch = mlflow_store._scratch
+    mlflow_store.close()
+    assert not scratch.exists()

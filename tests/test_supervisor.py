@@ -56,12 +56,26 @@ def test_preemption_mid_run_resumes_and_completes(tmp_path):
 def test_startup_failure_fails_fast_without_retries(tmp_path):
     """A child that raises a clean exception before EVER checkpointing (bad
     dataset path) is a deterministic startup error: the supervisor must
-    surface it after ONE attempt instead of paying max_restarts full
-    process bring-ups. (Signal deaths -- preemption, OOM kill -- stay
-    retryable even before the first checkpoint.)"""
+    surface it after TWO attempts (one retry is allowed, because transient
+    pre-first-checkpoint failures -- flaky shared FS, MemoryError -- also
+    exit rc=1) instead of paying max_restarts full process bring-ups.
+    (Signal deaths -- preemption, OOM kill -- stay retryable even before
+    the first checkpoint.)"""
     cfg = disk_cfg(tmp_path, dataset_dir=str(tmp_path / "missing"))
     with pytest.raises(RuntimeError, match="before its first checkpoint"):
         supervisor.run_supervised(cfg, TINY_MODEL, max_restarts=5)
+
+
+def test_stale_tmp_dir_does_not_count_as_started(tmp_path):
+    """A leftover orbax tmp dir from an interrupted save is NOT a completed
+    step: a deterministic startup error must still fail fast instead of
+    burning max_restarts (round-3 advice). A finalized digit-named step is
+    what flips the supervisor into retry mode (next test)."""
+    ckpt = tmp_path / "ckpt"
+    (ckpt / "3.orbax-checkpoint-tmp-1712").mkdir(parents=True)
+    assert not supervisor._has_completed_step(ckpt)
+    (ckpt / "3").mkdir()
+    assert supervisor._has_completed_step(ckpt)
 
 
 @pytest.mark.slow

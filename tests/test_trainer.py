@@ -90,6 +90,16 @@ def test_dice_loss_variant(tmp_path, arrays):
     assert np.isfinite(res.best_val_loss)
 
 
+def test_checkpoint_every_zero_rejected(tmp_path, arrays):
+    """0 would be a ZeroDivisionError deep in the epoch loop; negatives
+    would silently save every epoch (round-3 advice)."""
+    for bad in (0, -1):
+        cfg = tiny_cfg(tmp_path, checkpoint_every=bad)
+        with pytest.raises(ValueError, match="checkpoint_every"):
+            trainer.train_model(cfg, TINY_MODEL, arrays=arrays,
+                                register=False)
+
+
 def test_dataset_too_small(tmp_path):
     xs = np.zeros((1, 32, 32, 3), np.float32)
     ys = np.zeros((1, 32, 32, 1), np.float32)
