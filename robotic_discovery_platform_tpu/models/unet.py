@@ -123,26 +123,79 @@ def _norm(norm: str, dtype: DType, train: bool, features: int):
     raise ValueError(f"unknown norm {norm!r}")
 
 
+class TrainConv3x3(nn.Module):
+    """3x3 SAME no-bias conv backed by the custom-VJP Pallas kernels
+    (ops/pallas/conv.conv3x3: Pallas forward, Pallas dx and dw) so the
+    TRAINING step's hot op runs hand-written kernels too, not only the
+    folded inference path. Same parameter name/shape as ``nn.Conv``
+    ("kernel", [3, 3, Cin, Cout]), so checkpoints, torch-weight import,
+    and the PallasUNet variable walk are layout-identical.
+
+    The custom-VJP path engages only under ``train=True``: inference
+    consumers of ``model.apply`` keep the plain XLA conv (per-layer
+    Pallas/XLA mixing measures ~24% slower end-to-end, and the Pallas
+    serving path is the uniformly-fused ``PallasUNet``, not this module).
+    """
+
+    features: int
+    dtype: DType = jnp.bfloat16
+    kernel_init: Any = nn.initializers.lecun_normal()
+    impl: str = "auto"  # custom-VJP dispatch: auto | pallas | xla | interpret
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from robotic_discovery_platform_tpu.ops.pallas import conv as pconv
+
+        kernel = self.param(
+            "kernel", self.kernel_init,
+            (3, 3, x.shape[-1], self.features), jnp.float32,
+        )
+        x = x.astype(self.dtype)
+        kernel = kernel.astype(self.dtype)
+        if train:
+            return pconv.conv3x3(x, kernel, self.impl)
+        y = jax.lax.conv_general_dilated(
+            x, kernel, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        return y.astype(x.dtype)
+
+
 class DoubleConv(nn.Module):
     """(3x3 conv no-bias -> norm -> ReLU) x 2
-    (reference: pkg/segmentation_model.py:24-40)."""
+    (reference: pkg/segmentation_model.py:24-40).
+
+    ``conv_impl="flax"`` uses ``nn.Conv`` (XLA convs end to end);
+    anything else routes the convs through :class:`TrainConv3x3`'s
+    custom-VJP Pallas kernels with that dispatch mode.
+    """
 
     features: int
     mid_features: int | None = None
     norm: str = "batch"
     dtype: DType = jnp.bfloat16
     weight_init: str = "torch"
+    conv_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         mid = self.mid_features or self.features
         kinit = _kernel_init(self.weight_init)
-        x = nn.Conv(mid, (3, 3), padding="SAME", use_bias=False,
-                    dtype=self.dtype, kernel_init=kinit)(x)
+
+        def conv(features, name, y):
+            if self.conv_impl == "flax":
+                return nn.Conv(features, (3, 3), padding="SAME",
+                               use_bias=False, dtype=self.dtype,
+                               kernel_init=kinit, name=name)(y)
+            return TrainConv3x3(features, dtype=self.dtype,
+                                kernel_init=kinit, impl=self.conv_impl,
+                                name=name)(y, train)
+
+        x = conv(mid, "Conv_0", x)
         x = _norm(self.norm, self.dtype, train, mid)(x)
         x = nn.relu(x)
-        x = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
-                    dtype=self.dtype, kernel_init=kinit)(x)
+        x = conv(self.features, "Conv_1", x)
         x = _norm(self.norm, self.dtype, train, self.features)(x)
         return nn.relu(x)
 
@@ -154,12 +207,14 @@ class Down(nn.Module):
     norm: str = "batch"
     dtype: DType = jnp.bfloat16
     weight_init: str = "torch"
+    conv_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         return DoubleConv(self.features, norm=self.norm, dtype=self.dtype,
-                          weight_init=self.weight_init)(x, train)
+                          weight_init=self.weight_init,
+                          conv_impl=self.conv_impl)(x, train)
 
 
 class Up(nn.Module):
@@ -176,6 +231,7 @@ class Up(nn.Module):
     norm: str = "batch"
     dtype: DType = jnp.bfloat16
     weight_init: str = "torch"
+    conv_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, skip, train: bool = False):
@@ -187,7 +243,8 @@ class Up(nn.Module):
             x = jnp.concatenate([skip, x.astype(skip.dtype)], axis=-1)
             return DoubleConv(self.features, mid_features=mid,
                               norm=self.norm, dtype=self.dtype,
-                              weight_init=self.weight_init)(x, train)
+                              weight_init=self.weight_init,
+                              conv_impl=self.conv_impl)(x, train)
         in_ch = x.shape[3]
         # torch ConvTranspose2d computes init fan_in over weight dim 1
         # (out_channels) * kh * kw = (in_ch // 2) * 4 -- for BOTH kernel
@@ -206,7 +263,8 @@ class Up(nn.Module):
         x = jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method="nearest")
         x = jnp.concatenate([skip, x.astype(skip.dtype)], axis=-1)
         return DoubleConv(self.features, norm=self.norm, dtype=self.dtype,
-                          weight_init=self.weight_init)(x, train)
+                          weight_init=self.weight_init,
+                          conv_impl=self.conv_impl)(x, train)
 
 
 class UNet(nn.Module):
@@ -222,13 +280,15 @@ class UNet(nn.Module):
     dtype: DType = jnp.bfloat16
     in_features: int = 3  # used by init helpers; convs infer from input
     weight_init: str = "torch"
+    conv_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         f = self.base_features
         factor = 2 if self.bilinear else 1
         x = x.astype(self.dtype)
-        kw = dict(norm=self.norm, dtype=self.dtype, weight_init=self.weight_init)
+        kw = dict(norm=self.norm, dtype=self.dtype,
+                  weight_init=self.weight_init, conv_impl=self.conv_impl)
         x1 = DoubleConv(f, **kw)(x, train)
         x2 = Down(f * 2, **kw)(x1, train)
         x3 = Down(f * 4, **kw)(x2, train)
@@ -257,6 +317,7 @@ def build_unet(cfg: ModelConfig = ModelConfig()) -> UNet:
         dtype=jnp.dtype(cfg.compute_dtype),
         in_features=cfg.in_channels,
         weight_init=cfg.init,
+        conv_impl=cfg.conv_impl,
     )
 
 

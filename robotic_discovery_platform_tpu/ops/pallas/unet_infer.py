@@ -27,34 +27,34 @@ from flax import linen as nn
 from robotic_discovery_platform_tpu.models.unet import upsample_align_corners
 from robotic_discovery_platform_tpu.ops.pallas import conv as pconv
 
-# Measured v5e crossover: pallas <= threshold < xla. At batch 1 every
-# layer of the deployed 256^2 forward sits under the budget, so the whole
-# net runs Pallas-uniform; larger batches push the wide feature maps over
-# it (batched wide-map Pallas launches also overflow VMEM outright).
+# Measured v5e crossover for the UNIFORM whole-net choice: Pallas when the
+# widest layer's activation volume (b * h * w * 128 concat channels at
+# full resolution) stays within the budget, folded-XLA above it. At batch
+# 1 the deployed 256^2 forward sits exactly at the budget and runs
+# Pallas-uniform (r03: 544 vs 347 FPS over the unfolded Flax path; r04
+# full-pipeline: 413 vs 379); batched forwards run XLA-uniform (r04 b4:
+# 321 XLA vs 266 Pallas, and batched wide-map Pallas launches overflow
+# VMEM outright at b=8).
 #
-# Why not per-shape dispatch: PALLASBENCH.json's isolated-launch timings
+# Why not per-LAYER dispatch: PALLASBENCH.json's isolated-launch timings
 # show 3 of 16 conv shapes losing to XLA (0.48-0.64x), but rerouting just
 # those to XLA was measured 24% SLOWER end-to-end in the fused serving
-# graph (interleaved A/B: 472 vs 584 FPS) -- every pallas<->XLA boundary
-# pays a layout transition that outweighs the per-launch loss. The
-# dispatcher therefore optimizes the composed pipeline, not individual
-# launches; treat PALLASBENCH's per-shape rows as launch-level data only.
+# graph (interleaved A/B: 472 vs 584 FPS), and the r04 remeasure agrees
+# (mixed auto at b4: 457 FPS forward-only vs 814 XLA-uniform) -- every
+# pallas<->XLA boundary pays a layout transition that outweighs the
+# per-launch loss. The dispatcher therefore picks ONE backend for the
+# whole forward, per input shape.
 PALLAS_MAX_ELEMS = 2 ** 23
 
 
 def _dispatch_3x3(x, w, scale, bias, *, relu, interpret, force):
-    b, h, width, cin = x.shape
-    cout = w.shape[-1]
-    elems = b * h * width * max(cin, cout)
     if force == "xla" or (
         force is None and not (interpret or pconv.use_pallas())
     ):
         return pconv.conv3x3_bn_relu_xla(x, w, scale, bias, relu=relu)
-    if force == "pallas" or interpret or elems <= PALLAS_MAX_ELEMS:
-        return pconv.conv3x3_bn_relu(
-            x, w, scale, bias, relu=relu, interpret=interpret
-        )
-    return pconv.conv3x3_bn_relu_xla(x, w, scale, bias, relu=relu)
+    return pconv.conv3x3_bn_relu(
+        x, w, scale, bias, relu=relu, interpret=interpret
+    )
 
 
 class PallasUNet:
@@ -121,15 +121,30 @@ class PallasUNet:
 
     # -- forward --------------------------------------------------------
 
-    def _double_conv(self, x, taps):
+    def _uniform_force(self, x) -> str:
+        """ONE backend for the whole forward, per input shape (see the
+        PALLAS_MAX_ELEMS comment): "pallas" or "xla"."""
+        if self.force is not None:
+            return self.force
+        if self.interpret:
+            # interpret-mode tests exist to validate the Pallas kernels;
+            # the volume gate must never silently reroute them to XLA
+            return "pallas"
+        if not pconv.use_pallas():
+            return "xla"
+        b, h, w, _ = x.shape
+        widest = b * h * w * 2 * self.model.base_features
+        return "pallas" if widest <= PALLAS_MAX_ELEMS else "xla"
+
+    def _double_conv(self, x, taps, force):
         for w, scale, bias in taps:
             x = _dispatch_3x3(
                 x, w, scale, bias, relu=True,
-                interpret=self.interpret, force=self.force,
+                interpret=self.interpret, force=force,
             )
         return x
 
-    def _up(self, x, skip, layer):
+    def _up(self, x, skip, layer, force):
         b, h, w, c = skip.shape
         if self.model.bilinear:
             x = upsample_align_corners(x, h, w)
@@ -137,33 +152,34 @@ class PallasUNet:
             wk, bias = layer["convt"]
             x = pconv.conv_transpose2x2(
                 x, wk, bias, interpret=self.interpret
-            ) if (self.force != "xla" and (
+            ) if (force != "xla" and (
                 self.interpret or pconv.use_pallas()
             )) else pconv.conv_transpose2x2_xla(x, wk, bias)
             x = jax.image.resize(
                 x, (x.shape[0], h, w, x.shape[3]), method="nearest"
             )
         x = jnp.concatenate([skip, x.astype(skip.dtype)], axis=-1)
-        return self._double_conv(x, layer["dc"])
+        return self._double_conv(x, layer["dc"], force)
 
     def __call__(self, x):
         """NHWC input -> NHWC f32 logits, same contract as
         ``model.apply(variables, x, train=False)``."""
         L = self._layers
+        force = self._uniform_force(x)
         x = x.astype(self.model.dtype)
-        x1 = self._double_conv(x, L["inc"])
+        x1 = self._double_conv(x, L["inc"], force)
         xs = [x1]
         for i in range(4):
             x = nn.max_pool(xs[-1], (2, 2), strides=(2, 2))
-            xs.append(self._double_conv(x, L[f"down{i}"]))
+            xs.append(self._double_conv(x, L[f"down{i}"], force))
         y = xs[4]
         for i in range(4):
-            y = self._up(y, xs[3 - i], L[f"up{i}"])
+            y = self._up(y, xs[3 - i], L[f"up{i}"], force)
         w, scale, bias = L["head"]
         logits = pconv.conv1x1(
             y, w, scale, bias, relu=False, out_dtype=jnp.float32,
             interpret=self.interpret,
-        ) if (self.force != "xla" and (
+        ) if (force != "xla" and (
             self.interpret or pconv.use_pallas()
         )) else pconv.conv1x1_xla(
             y, w, scale, bias, relu=False, out_dtype=jnp.float32

@@ -70,6 +70,7 @@ class BatchDispatcher:
         self._max_batch = max_batch
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._stopped = threading.Event()
+        self._submit_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, name="batch-dispatcher", daemon=True
         )
@@ -80,20 +81,38 @@ class BatchDispatcher:
     def submit(self, frame_rgb, depth, intrinsics, depth_scale):
         """Block until this frame's analysis is available; returns the
         unbatched FrameAnalysis slice (host numpy leaves)."""
-        if self._stopped.is_set():
-            raise RuntimeError("dispatcher stopped")
         p = _Pending(frame_rgb, depth, np.asarray(intrinsics, np.float32),
                      float(depth_scale))
-        self._q.put(p)
+        # enqueue under the lock stop() drains under: a submit either lands
+        # BEFORE the drain (and is error-completed by it) or observes
+        # stopped and raises -- it can never enqueue after the drain and
+        # block forever on done.wait()
+        with self._submit_lock:
+            if self._stopped.is_set():
+                raise RuntimeError("dispatcher stopped")
+            self._q.put(p)
         p.done.wait()
         if p.error is not None:
             raise p.error
         return p.result
 
     def stop(self) -> None:
-        self._stopped.set()
-        self._q.put(None)
+        """Idempotent. Every pending or racing submit is completed (with a
+        'dispatcher stopped' error if its frame was never dispatched);
+        no caller is left blocked."""
+        with self._submit_lock:
+            self._stopped.set()
+            self._q.put(None)
         self._thread.join(timeout=5)
+        # error-complete anything the collector left behind
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and not item.done.is_set():
+                item.error = RuntimeError("dispatcher stopped")
+                item.done.set()
 
     # -- collector side -----------------------------------------------------
 

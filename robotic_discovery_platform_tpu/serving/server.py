@@ -18,8 +18,10 @@ the reference's documented-but-missing behaviors implemented:
 
 from __future__ import annotations
 
+import threading
 import time
 from concurrent import futures
+from typing import Any, NamedTuple
 
 import numpy as np
 
@@ -41,24 +43,65 @@ from robotic_discovery_platform_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 
-def resolve_serving_model(cfg: ServerConfig):
-    """staging alias first, latest fallback. Returns (model, variables)."""
-    tracking.set_tracking_uri(cfg.tracking_uri)
-    alias_uri = f"models:/{cfg.model_name}@{cfg.model_alias}"
+_resolve_warn_ts = [0.0]  # rate limit for the unreachable-registry warning
+
+
+def resolve_serving_version(cfg: ServerConfig) -> int | None:
+    """The registry version serving should run: the ``staging`` alias when
+    set, else the latest version; None when the registry is empty or
+    unreachable (callers decide whether that is fatal). Failures are
+    logged (rate-limited to one per minute) so a silently-broken registry
+    doesn't make the hot-reload poller inert with zero diagnostics."""
     try:
-        model, variables = tracking.load_model(alias_uri)
-        log.info("loaded %s", alias_uri)
-        return model, variables
-    except (KeyError, FileNotFoundError):
-        latest_uri = f"models:/{cfg.model_name}/latest"
-        model, variables = tracking.load_model(latest_uri)
-        log.info("no %r alias; loaded %s", cfg.model_alias, latest_uri)
-        return model, variables
+        tracking.set_tracking_uri(cfg.tracking_uri)
+        client = tracking.Client()
+        try:
+            return client.get_model_version_by_alias(
+                cfg.model_name, cfg.model_alias
+            ).version
+        except (KeyError, FileNotFoundError):
+            return client.get_latest_versions(cfg.model_name)[0].version
+    except Exception as exc:
+        now = time.monotonic()
+        if now - _resolve_warn_ts[0] > 60.0:
+            _resolve_warn_ts[0] = now
+            log.warning(
+                "registry %s unreachable/empty (%s: %s); serving keeps its "
+                "current model", cfg.tracking_uri, type(exc).__name__, exc,
+            )
+        return None
+
+
+def resolve_serving_model(cfg: ServerConfig):
+    """staging alias first, latest fallback.
+    Returns (model, variables, version)."""
+    tracking.set_tracking_uri(cfg.tracking_uri)
+    version = resolve_serving_version(cfg)
+    if version is not None:
+        uri = f"models:/{cfg.model_name}/{version}"
+        model, variables = tracking.load_model(uri)
+        log.info("loaded %s (alias %r first)", uri, cfg.model_alias)
+        return model, variables, version
+    # fall through for the error message of the plain path
+    model, variables = tracking.load_model(f"models:/{cfg.model_name}/latest")
+    return model, variables, None
 
 
 def _default_intrinsics(w: int, h: int) -> np.ndarray:
     f = 0.94 * w
     return np.array([[f, 0, w / 2], [0, f, h / 2], [0, 0, 1]], np.float64)
+
+
+class Engine(NamedTuple):
+    """One served model generation: everything a frame touches, swapped as
+    a unit so a hot-reload can never mix old variables with a new forward
+    (SURVEY.md section 3.4: the reference's promotion only takes effect at
+    restart -- 'a running server keeps its old model')."""
+
+    analyze: Any
+    variables: Any
+    dispatcher: Any
+    version: int | None
 
 
 class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
@@ -71,17 +114,44 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         cfg: ServerConfig = ServerConfig(),
         geom_cfg: GeometryConfig = GeometryConfig(),
         metrics: MetricsWriter | None = None,
+        version: int | None = None,
     ):
         self.cfg = cfg
-        self.variables = variables
+        self.geom_cfg = geom_cfg
         self.intrinsics = intrinsics
         self.depth_scale = depth_scale
+        self._engine = self._make_engine(model, variables, version)
+        self._warm_shape: tuple[int, int] | None = None
+        self._reload_stop: threading.Event | None = None
+        self._reload_thread: threading.Thread | None = None
+        self.metrics = metrics or MetricsWriter(
+            cfg.metrics_csv, cfg.metrics_flush_every
+        )
+
+    @property
+    def variables(self):
+        return self._engine.variables
+
+    @property
+    def analyze(self):
+        return self._engine.analyze
+
+    @property
+    def dispatcher(self):
+        return self._engine.dispatcher
+
+    @property
+    def current_version(self) -> int | None:
+        return self._engine.version
+
+    def _make_engine(self, model, variables, version) -> Engine:
+        cfg, geom_cfg = self.cfg, self.geom_cfg
         forward = self._build_forward(model, variables, cfg)
-        self.analyze = pipeline.make_frame_analyzer(
+        analyze = pipeline.make_frame_analyzer(
             model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
             forward=forward,
         )
-        self.dispatcher = None
+        dispatcher = None
         if cfg.batch_window_ms > 0:
             from robotic_discovery_platform_tpu.serving.batching import (
                 BatchDispatcher,
@@ -91,16 +161,14 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
                 forward=forward,
             )
-            self.dispatcher = BatchDispatcher(
+            dispatcher = BatchDispatcher(
                 lambda frames, depths, intr, scales: batch_analyze(
-                    self.variables, frames, depths, intr, scales
+                    variables, frames, depths, intr, scales
                 ),
                 window_ms=cfg.batch_window_ms,
                 max_batch=cfg.max_batch,
             )
-        self.metrics = metrics or MetricsWriter(
-            cfg.metrics_csv, cfg.metrics_flush_every
-        )
+        return Engine(analyze, variables, dispatcher, version)
 
     @staticmethod
     def _build_forward(model, variables, cfg: ServerConfig):
@@ -143,15 +211,18 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         h, w = color_bgr.shape[:2]
         k = self.intrinsics if self.intrinsics is not None else _default_intrinsics(w, h)
         rgb = np.ascontiguousarray(color_bgr[..., ::-1])  # BGR -> RGB
+        # ONE read of the engine per frame: analyze/variables/dispatcher
+        # swap together, so a concurrent hot-reload cannot mix generations
+        eng = self._engine
         with timer.stage("device"):
-            if self.dispatcher is not None:
+            if eng.dispatcher is not None:
                 # coalesce with co-arriving frames from other streams
-                out = self.dispatcher.submit(
+                out = eng.dispatcher.submit(
                     rgb, depth, np.asarray(k, np.float32), self.depth_scale
                 )
             else:
-                out = self.analyze(
-                    self.variables,
+                out = eng.analyze(
+                    eng.variables,
                     rgb,
                     depth,
                     np.asarray(k, np.float32),
@@ -207,11 +278,70 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         if timer.totals:
             log.info("stream stage breakdown: %s", timer.summary())
 
+    # -- hot-reload ---------------------------------------------------------
+
+    def start_reloader(self) -> None:
+        """Poll the registry every ``cfg.reload_poll_s`` seconds; when the
+        staging alias (or latest version) moves, build + warm the new
+        model OFF the serving path and atomically swap it in -- promotion
+        takes effect on a RUNNING server, closing the reference's
+        implicit-handoff gap (SURVEY.md section 3.4)."""
+        if self.cfg.reload_poll_s <= 0 or self._reload_thread is not None:
+            return
+        self._reload_stop = threading.Event()
+
+        def loop():
+            while not self._reload_stop.wait(self.cfg.reload_poll_s):
+                try:
+                    self.maybe_reload()
+                except Exception:
+                    log.exception("model hot-reload failed; keeping current")
+
+        self._reload_thread = threading.Thread(
+            target=loop, name="model-reloader", daemon=True
+        )
+        self._reload_thread.start()
+
+    def maybe_reload(self) -> bool:
+        """One reload check; returns True when a new version was swapped in."""
+        version = resolve_serving_version(self.cfg)
+        if version is None or version == self._engine.version:
+            return False
+        model, variables = tracking.load_model(
+            f"models:/{self.cfg.model_name}/{version}"
+        )
+        engine = self._make_engine(model, variables, version)
+        if self._warm_shape is not None:
+            # compile + run once off the serving path so in-flight streams
+            # never pay the new graph's XLA compilation
+            w, h = self._warm_shape
+            k = (self.intrinsics if self.intrinsics is not None
+                 else _default_intrinsics(w, h))
+            engine.analyze(
+                engine.variables,
+                np.zeros((h, w, 3), np.uint8),
+                np.zeros((h, w), np.uint16),
+                np.asarray(k, np.float32),
+                np.float32(self.depth_scale),
+            )
+        old, self._engine = self._engine, engine
+        if old.dispatcher is not None:
+            # Grace-delayed stop: a frame thread that read the OLD engine
+            # just before the swap may still be about to submit(); give
+            # in-flight frames ample time to finish on the old dispatcher
+            # before tearing it down (stop() itself is drain-safe, so a
+            # straggler past the grace window gets a per-frame error, not
+            # a hang -- and per-frame errors don't drop the stream).
+            threading.Timer(10.0, old.dispatcher.stop).start()
+        log.info("hot-reloaded model: version %s -> %s", old.version, version)
+        return True
+
     def warmup(self, width: int, height: int) -> None:
         """Pre-compile the fused graph for a camera geometry so the first
         real frame does not pay XLA compilation."""
         import cv2
 
+        self._warm_shape = (width, height)
         dummy = np.zeros((height, width, 3), np.uint8)
         ok, png = cv2.imencode(".png", np.zeros((height, width), np.uint16))
         req = vision_pb2.AnalysisRequest(
@@ -224,14 +354,15 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         )
         color, depth = self._decode(req)
         self._analyze_frame(color, depth)
-        if self.dispatcher is not None:
+        dispatcher = self._engine.dispatcher
+        if dispatcher is not None:
             # pre-compile every micro-batch bucket so a load burst does not
             # pay XLA compilation mid-stream
             k = (self.intrinsics if self.intrinsics is not None
                  else _default_intrinsics(width, height))
             b = 1
             while b <= self.cfg.max_batch:
-                self.dispatcher._analyze(
+                dispatcher._analyze(
                     np.zeros((b, height, width, 3), np.uint8),
                     np.zeros((b, height, width), np.uint16),
                     np.repeat(np.asarray(k, np.float32)[None], b, 0),
@@ -242,8 +373,13 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                  jax.default_backend())
 
     def close(self) -> None:
-        if self.dispatcher is not None:
-            self.dispatcher.stop()
+        if self._reload_stop is not None:
+            self._reload_stop.set()
+        if self._reload_thread is not None:
+            self._reload_thread.join(timeout=5)
+            self._reload_thread = None
+        if self._engine.dispatcher is not None:
+            self._engine.dispatcher.stop()
         self.metrics.flush()
 
 
@@ -261,7 +397,7 @@ def build_server(
     override (e.g. stride=1 for reference-exact dense semantics)."""
     if geom_cfg is None:
         geom_cfg = GeometryConfig(stride=cfg.geometry_stride)
-    model, variables = resolve_serving_model(cfg)
+    model, variables, version = resolve_serving_model(cfg)
     intrinsics = None
     depth_scale = cfg.default_depth_scale
     try:
@@ -275,10 +411,12 @@ def build_server(
             cfg.calibration_path, exc,
         )
     servicer = VisionAnalysisService(
-        model, variables, intrinsics, depth_scale, cfg, geom_cfg
+        model, variables, intrinsics, depth_scale, cfg, geom_cfg,
+        version=version,
     )
     if warmup_shape is not None:
         servicer.warmup(*warmup_shape)
+    servicer.start_reloader()
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=cfg.max_workers))
     vision_grpc.add_VisionAnalysisServiceServicer_to_server(servicer, server)
     server.add_insecure_port(cfg.address)

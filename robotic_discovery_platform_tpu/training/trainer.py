@@ -269,6 +269,12 @@ def train_model(
     if len(val_idx) == 0:
         raise ValueError("dataset too small for a validation split")
 
+    if mesh is not None and model_cfg.conv_impl != "flax":
+        # the custom-VJP Pallas convs carry no pjit partitioning rules;
+        # under a mesh the nn.Conv/XLA path is the sharding-correct one
+        from robotic_discovery_platform_tpu.utils.config import replace as _rep
+
+        model_cfg = _rep(model_cfg, conv_impl="flax")
     model = build_unet(model_cfg)
     tx = optax.adam(cfg.learning_rate)
     loss_fn = losses_lib.make_loss_fn(cfg.loss, cfg.dice_weight)

@@ -93,7 +93,8 @@ def test_autonomous_loop(tmp_path):
     tracking.set_tracking_uri(uri)
     v2_path = tracking.resolve_model_uri("models:/Actuator-Segmenter@staging")
     assert v2_path == tracking.resolve_model_uri("models:/Actuator-Segmenter/2")
-    model2, vars2 = server_lib.resolve_serving_model(server_cfg)
+    model2, vars2, v2_resolved = server_lib.resolve_serving_model(server_cfg)
+    assert v2_resolved == 2
     _, vars_v2 = tracking.load_model("models:/Actuator-Segmenter/2")
     leaves_a = [np.asarray(x) for x in
                 __import__("jax").tree.leaves(vars2["params"])]

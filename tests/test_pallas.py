@@ -142,5 +142,81 @@ def test_dispatch_policy():
     got = _dispatch_3x3(x, k, s, b, relu=True, interpret=False, force=None)
     want = conv3x3_bn_relu_xla(x, k, s, b)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
-    assert 1 * 256 * 256 * 64 <= PALLAS_MAX_ELEMS  # serving B=1 uses pallas
-    assert 8 * 256 * 256 * 64 > PALLAS_MAX_ELEMS  # batched B=8 uses XLA
+    # uniform whole-net rule (PallasUNet._uniform_force): widest-layer
+    # volume b*h*w*(2*base) against the measured crossover
+    assert 1 * 256 * 256 * 128 <= PALLAS_MAX_ELEMS  # serving B=1: pallas
+    assert 4 * 256 * 256 * 128 > PALLAS_MAX_ELEMS  # batched B>=4: XLA
+
+
+def test_conv3x3_custom_vjp_matches_autodiff():
+    """Forward, dx, and dw of the training-path custom-VJP conv
+    (ops/pallas/conv.conv3x3: Pallas forward + backward kernels) must match
+    XLA conv autodiff to f32 tolerance."""
+    from robotic_discovery_platform_tpu.ops.pallas.conv import (
+        conv3x3,
+        conv3x3_grad_weights,
+        conv3x3_grad_weights_xla,
+    )
+
+    x = _rand(2, 16, 24, 8)
+    k = _rand(3, 3, 8, 16, scale=0.1)
+    g = _rand(2, 16, 24, 16)
+
+    def f_ref(x, k):
+        return jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+
+    y_ref, vjp_ref = jax.vjp(f_ref, x, k)
+    dx_ref, dw_ref = vjp_ref(g)
+    y, vjp = jax.vjp(lambda a, b: conv3x3(a, b, "pallas", True), x, k)
+    dx, dw = vjp(g)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref),
+                               atol=1e-4, rtol=1e-5)
+    # the standalone dw kernel against its XLA oracle
+    np.testing.assert_allclose(
+        np.asarray(conv3x3_grad_weights(x, g, interpret=True)),
+        np.asarray(conv3x3_grad_weights_xla(x, g)),
+        atol=1e-4, rtol=1e-5,
+    )
+
+
+@pytest.mark.slow
+def test_train_step_with_pallas_convs_matches_flax():
+    """One full optimizer step on a tiny U-Net: conv_impl="interpret"
+    (custom-VJP Pallas convs) must reproduce the nn.Conv training step's
+    loss and updated params (round-3 verdict item 3)."""
+    import optax
+
+    from robotic_discovery_platform_tpu.models import losses as losses_lib
+    from robotic_discovery_platform_tpu.models.unet import build_unet
+    from robotic_discovery_platform_tpu.training import trainer
+    from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+    x = _rand(1, 16, 16, 3)
+    y = jnp.asarray(RNG.random((1, 16, 16, 1)) > 0.5, jnp.float32)
+    loss_fn = losses_lib.make_loss_fn("bce", 0.5)
+    tx = optax.adam(1e-3)
+    out = {}
+    for impl in ("flax", "interpret"):
+        mc = ModelConfig(base_features=4, compute_dtype="float32",
+                         conv_impl=impl)
+        model = build_unet(mc)
+        state = trainer.create_state(model, tx, jax.random.key(0), 16)
+        step = trainer.core_train_step(model, tx, loss_fn)
+        state2, loss = step(state, x, y)
+        out[impl] = (state2, float(loss))
+    assert abs(out["flax"][1] - out["interpret"][1]) < 1e-5
+    deltas = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))),
+        out["flax"][0].params, out["interpret"][0].params,
+    )
+    # Adam normalizes by sqrt(nu): where a gradient element is ~0, f32
+    # sum-order differences between the conv impls can flip its sign and
+    # move that element by up to ~2*lr (the test_parallel.py caveat), so
+    # the bound is loose there and tight on loss above.
+    assert max(jax.tree.leaves(deltas)) < 5e-3

@@ -85,8 +85,27 @@ def test_shard_map_matches_pjit():
     np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=1e-5)
 
 
-def test_tensor_parallel_shards_wide_kernels():
+def _assert_matches_single_device(mesh_state, mesh_loss, single_state,
+                                  single_loss):
+    """Shared equivalence assertion: pjit partitions the SAME global-view
+    program, so loss and the post-step params must agree with the
+    single-device step up to f32 cross-device reduction order (the Adam
+    sqrt(nu) sign-flip caveat of test_dp_matches_single_device)."""
+    np.testing.assert_allclose(float(single_loss), float(mesh_loss),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(single_state.params),
+                    jax.tree.leaves(mesh_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+
+
+def test_tensor_parallel_matches_single_device():
+    """dp x tp: channel-sharded kernels must not change the numbers --
+    sharded placement AND numerical equivalence (round-3 verdict item 4)."""
     model, tx, state, loss_fn = _setup()
+    x, y = _batch(8)
+    single = trainer.make_train_step(model, tx, loss_fn, donate=False)
+    s1, loss1 = single(state, x, y)
+
     mesh = parallel.make_mesh(MeshConfig(data=4, model=2))
     train, _, sharded = parallel.parallelize_training(
         mesh, model, tx, loss_fn, state, donate=False, tp=True, tp_min_channels=64
@@ -98,40 +117,53 @@ def test_tensor_parallel_shards_wide_kernels():
         if s and s[-1] == "model"
     )
     assert n_sharded > 0
-    x, y = _batch(8)
     s2, loss = train(sharded, x, y)
-    assert np.isfinite(float(loss))
     # a wide kernel is distributed over multiple devices
     wide = [
         leaf for leaf in jax.tree.leaves(s2.params)
         if leaf.ndim == 4 and leaf.shape[-1] >= 64
     ]
     assert any(len(w.sharding.device_set) > 1 for w in wide)
+    _assert_matches_single_device(s2, loss, s1, loss1)
 
 
-def test_spatial_sharding_runs():
+def test_spatial_sharding_matches_single_device():
+    """dp x sp: H-sharded activations (XLA halo exchanges) must reproduce
+    the single-device numbers -- BatchNorm statistics over spatially
+    sharded maps are exactly the silent-divergence risk this pins down
+    (round-3 verdict item 4)."""
     model, tx, state, loss_fn = _setup()
+    x, y = _batch(8)
+    single = trainer.make_train_step(model, tx, loss_fn, donate=False)
+    single_eval = trainer.make_eval_step(model, loss_fn)
+    s1, loss1 = single(state, x, y)
+    m1 = single_eval(s1, x, y)
+
     mesh = parallel.make_mesh(MeshConfig(data=2, spatial=4))
     train, evals, sharded = parallel.parallelize_training(
         mesh, model, tx, loss_fn, state, donate=False
     )
-    x, y = _batch(8)
     s2, loss = train(sharded, x, y)
-    assert np.isfinite(float(loss))
-    m = evals(s2, x, y)
-    assert 0.0 <= float(m["miou"]) <= 1.0
+    _assert_matches_single_device(s2, loss, s1, loss1)
+    m2 = evals(s2, x, y)
+    for k in m1:
+        np.testing.assert_allclose(float(m1[k]), float(m2[k]), atol=1e-4)
 
 
-def test_full_mesh_dp_sp_tp():
-    """All three axes at once: 2x2x2 over 8 virtual chips."""
+def test_full_mesh_dp_sp_tp_matches_single_device():
+    """All three axes at once: 2x2x2 over 8 virtual chips, equivalent to
+    the single-device step (round-3 verdict item 4)."""
     model, tx, state, loss_fn = _setup()
+    x, y = _batch(8)
+    single = trainer.make_train_step(model, tx, loss_fn, donate=False)
+    s1, loss1 = single(state, x, y)
+
     mesh = parallel.make_mesh(MeshConfig(data=2, spatial=2, model=2))
     train, _, sharded = parallel.parallelize_training(
         mesh, model, tx, loss_fn, state, donate=False, tp_min_channels=64
     )
-    x, y = _batch(8)
-    _, loss = train(sharded, x, y)
-    assert np.isfinite(float(loss))
+    s2, loss = train(sharded, x, y)
+    _assert_matches_single_device(s2, loss, s1, loss1)
 
 
 def test_train_model_with_mesh(tmp_path):
