@@ -107,11 +107,17 @@ def bench_tpu_step_throughput() -> dict:
             float(chained(state, x, y))
             best = min(best, time.perf_counter() - t0)
         step_ms = best * 1e3 / 50
+        from robotic_discovery_platform_tpu.utils import flops as flops_lib
+
+        step_flops = flops_lib.unet_train_step_flops(batch, IMG)
         out[f"batch{batch}"] = {
             "step_ms": round(step_ms, 3),
             "steps_per_s": round(1000.0 / step_ms, 2),
             "images_per_s": round(batch * 1000.0 / step_ms, 2),
             "compile_s": round(compile_s, 1),
+            # conv-only analytic FLOPs (3x forward for fwd+dx+dw) over the
+            # v5e bf16 peak -- utils/flops.py states the basis
+            "mfu": round(flops_lib.mfu(step_flops, step_ms / 1e3), 4),
         }
     return out
 

@@ -68,30 +68,29 @@ def put_global_batch(mesh: Mesh, x, spatial: bool = False):
 
     Single-process: a plain transfer (GSPMD shards it). Multi-host: every
     process holds the same global batch (loaders are seed-deterministic),
-    and each contributes its contiguous row block to the global array via
-    ``jax.make_array_from_process_local_data`` -- rows map to processes in
-    device order because ``make_mesh`` builds from ``jax.devices()``.
+    and each materializes exactly the shards its local devices own via
+    ``jax.make_array_from_callback`` -- fully general over the mesh
+    layout, including data axes smaller than the process count (a data
+    shard replicated across several hosts) and spatial/tensor axes that
+    split a host's devices across non-contiguous row blocks. The earlier
+    contiguous-row-block scheme rejected those layouts by construction
+    (round-3 verdict item 9).
     """
+    import numpy as np
+
     import jax.numpy as jnp
 
     sharding = mesh_lib.batch_sharding(mesh, spatial=spatial)
     if jax.process_count() == 1:
         return jax.device_put(jnp.asarray(x), sharding)
-    procs = jax.process_count()
     data = dict(mesh.shape).get("data", 1)
-    if data % procs:
+    if x.shape[0] % data:
         raise ValueError(
-            f"multi-host batching needs the data axis ({data}) to be a "
-            f"multiple of the process count ({procs}) so each process owns "
-            "a contiguous row block"
+            f"global batch {x.shape[0]} not divisible by the data axis "
+            f"({data})"
         )
-    if x.shape[0] % procs:
-        raise ValueError(
-            f"global batch {x.shape[0]} not divisible by {procs} processes"
-        )
-    per = x.shape[0] // procs
-    lo = jax.process_index() * per
-    return jax.make_array_from_process_local_data(sharding, x[lo:lo + per])
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
 
 
 def parallelize_training(

@@ -450,123 +450,145 @@ def train_model(
     registry_version = None
     final_metrics: dict = {}
 
-    with run_ctx as run:
-        if is_main:
-            tracking.log_params(
-                {
-                    # exact reference param-name surface
-                    # (train_segmenter.py:119-128)
-                    "learning_rate": cfg.learning_rate,
-                    "batch_size": batch_size,
-                    "epochs": cfg.epochs,
-                    "validation_split": cfg.validation_split,
-                    "image_size": cfg.img_size,
-                    "optimizer": "adam",
-                    "loss": cfg.loss,
-                    "model": "UNet",
-                    "bilinear": model_cfg.bilinear,
-                    "base_features": model_cfg.base_features,
-                    "backend": jax.default_backend(),
-                    "num_devices": divisor,
-                }
-            )
-
-        epoch_seconds: list = []
-        start_epoch = min(int(state.epoch), cfg.epochs)
-        if int(state.epoch) >= cfg.epochs:
-            log.warning(
-                "checkpoint epoch %d >= cfg.epochs %d; nothing to train, "
-                "evaluating only", int(state.epoch), cfg.epochs,
-            )
-            final_metrics = run_val()
-        for epoch in range(start_epoch, cfg.epochs):
-            t_epoch = time.time()
-            if use_scan:
-                order = jnp.asarray(data_lib.epoch_order(
-                    len(train_idx), batch_size, True, order_rng
-                ))
-                state, loss = train_epoch(state, xs_tr, ys_tr, order)
-                train_loss = float(loss)
-            else:
-                train_losses = []
-                for bx, by in train_batches:
-                    state, loss = train_step(
-                        state, to_device(bx), to_device(by)
-                    )
-                    train_losses.append(loss)
-                train_loss = float(np.mean([float(l) for l in train_losses]))
-
-            val = run_val()
-            final_metrics = val
-
+    # close() in finally: an exception mid-training must still
+    # drain (or surface the failure of) any in-flight async save
+    # -- abandoning the daemon worker would silently lose the
+    # checkpoint it was writing
+    try:
+        with run_ctx as run:
             if is_main:
-                tracking.log_metric("train_loss", train_loss, step=epoch)
-                tracking.log_metric("val_loss", val["loss"], step=epoch)
-                tracking.log_metric("val_miou", val["miou"], step=epoch)
-                tracking.log_metric("val_dice", val["dice"], step=epoch)
-            epoch_seconds.append(time.time() - t_epoch)
-            log.info(
-                "epoch %d/%d train_loss=%.4f val_loss=%.4f miou=%.4f (%.1fs)",
-                epoch + 1, cfg.epochs, train_loss, val["loss"], val["miou"],
-                epoch_seconds[-1],
-            )
-
-            if val["loss"] < float(state.best_val_loss):
-                state = state.replace(
-                    best_val_loss=scalarize(val["loss"], jnp.float32)
+                tracking.log_params(
+                    {
+                        # exact reference param-name surface
+                        # (train_segmenter.py:119-128)
+                        "learning_rate": cfg.learning_rate,
+                        "batch_size": batch_size,
+                        "epochs": cfg.epochs,
+                        "validation_split": cfg.validation_split,
+                        "image_size": cfg.img_size,
+                        "optimizer": "adam",
+                        "loss": cfg.loss,
+                        "model": "UNet",
+                        "bilinear": model_cfg.bilinear,
+                        "base_features": model_cfg.base_features,
+                        "backend": jax.default_backend(),
+                        "num_devices": divisor,
+                    }
                 )
-                best_params, best_stats = _copy_tree(
-                    (state.params, state.batch_stats)
+
+            epoch_seconds: list = []
+            start_epoch = min(int(state.epoch), cfg.epochs)
+            if int(state.epoch) >= cfg.epochs:
+                log.warning(
+                    "checkpoint epoch %d >= cfg.epochs %d; nothing to train, "
+                    "evaluating only", int(state.epoch), cfg.epochs,
                 )
+                final_metrics = run_val()
+            for epoch in range(start_epoch, cfg.epochs):
+                t_epoch = time.time()
+                if use_scan:
+                    order = jnp.asarray(data_lib.epoch_order(
+                        len(train_idx), batch_size, True, order_rng
+                    ))
+                    state, loss = train_epoch(state, xs_tr, ys_tr, order)
+                    train_loss = float(loss)
+                else:
+                    train_losses = []
+                    for bx, by in train_batches:
+                        state, loss = train_step(
+                            state, to_device(bx), to_device(by)
+                        )
+                        train_losses.append(loss)
+                    train_loss = float(np.mean([float(l) for l in train_losses]))
 
-            state = state.replace(epoch=scalarize(epoch + 1, jnp.int32))
-            if (epoch + 1) % cfg.checkpoint_every and epoch + 1 < cfg.epochs:
-                continue
-            # Collective: every process calls save; orbax coordinates its
-            # own cross-host barriers and each host writes its shards.
-            payload = {
-                "state": state,
-                "best_params": (
-                    best_params if best_params is not None
-                    else state.params
-                ),
-                "best_stats": (
-                    best_stats if best_stats is not None
-                    else state.batch_stats
-                ),
-            }
-            if jax.process_count() == 1:
-                # single-controller: ONE bulk device fetch, then orbax
-                # writes numpy -- letting orbax pull device arrays leaf by
-                # leaf costs a full host<->device round-trip per leaf
-                # (~270 leaves x ~110 ms through this image's relay)
-                payload = jax.device_get(payload)
-            ckpt.save(epoch + 1, payload)
+                val = run_val()
+                final_metrics = val
 
-        if is_main:
-            tracking.log_metric("best_val_loss", float(state.best_val_loss))
-
-        if register and best_params is not None:
-            # collective all-gather of any TP-sharded leaves, then host fetch
-            # on every process; only process 0 writes the registry
-            host_params = _fetch_to_host(best_params)
-            host_stats = _fetch_to_host(best_stats)
-            if is_main:
-                variables = {"params": host_params}
-                if host_stats:
-                    variables["batch_stats"] = host_stats
-                registry_version = tracking.log_model(
-                    variables, model_cfg,
-                    registered_model_name=cfg.registered_model_name,
-                )
+                if is_main:
+                    tracking.log_metric("train_loss", train_loss, step=epoch)
+                    tracking.log_metric("val_loss", val["loss"], step=epoch)
+                    tracking.log_metric("val_miou", val["miou"], step=epoch)
+                    tracking.log_metric("val_dice", val["dice"], step=epoch)
+                epoch_seconds.append(time.time() - t_epoch)
                 log.info(
-                    "registered %s version %s", cfg.registered_model_name,
-                    registry_version,
+                    "epoch %d/%d train_loss=%.4f val_loss=%.4f miou=%.4f (%.1fs)",
+                    epoch + 1, cfg.epochs, train_loss, val["loss"], val["miou"],
+                    epoch_seconds[-1],
                 )
 
-        run_id = run.info.run_id
+                if val["loss"] < float(state.best_val_loss):
+                    state = state.replace(
+                        best_val_loss=scalarize(val["loss"], jnp.float32)
+                    )
+                    best_params, best_stats = _copy_tree(
+                        (state.params, state.batch_stats)
+                    )
 
-    ckpt.close()
+                state = state.replace(epoch=scalarize(epoch + 1, jnp.int32))
+                if (epoch + 1) % cfg.checkpoint_every and epoch + 1 < cfg.epochs:
+                    continue
+                # Collective: every process calls save; orbax coordinates its
+                # own cross-host barriers and each host writes its shards.
+                payload = {
+                    "state": state,
+                    "best_params": (
+                        best_params if best_params is not None
+                        else state.params
+                    ),
+                    "best_stats": (
+                        best_stats if best_stats is not None
+                        else state.batch_stats
+                    ),
+                }
+                if jax.process_count() == 1 and cfg.async_checkpointing:
+                    # single-controller: snapshot to independent device buffers
+                    # (cheap HBM copy, and required -- the live state is donated
+                    # into the next epoch's step), then a background worker pays
+                    # the ONE bulk host fetch + disk write while the next
+                    # epoch's compute runs. Letting orbax pull device arrays
+                    # leaf by leaf would cost a round-trip per leaf (~270
+                    # leaves x ~110 ms through this image's relay); doing the
+                    # fetch synchronously serialized ~350 MB of relay traffic
+                    # into every epoch (round-3 verdict item 7).
+                    # wait for the PREVIOUS epoch's save before building the
+                    # new snapshot: otherwise three copies of the state (live
+                    # + old snapshot + new snapshot) coexist in HBM whenever
+                    # saves run longer than epochs
+                    ckpt.wait()
+                    ckpt.save_async(epoch + 1, _copy_tree(payload))
+                elif jax.process_count() == 1:
+                    # synchronous opt-out keeps the one-bulk-fetch shape
+                    ckpt.save(epoch + 1, jax.device_get(payload))
+                else:
+                    # multi-host saves are collective; orbax's cross-host
+                    # barriers must run in lockstep on every process
+                    ckpt.save(epoch + 1, payload)
+
+            if is_main:
+                tracking.log_metric("best_val_loss", float(state.best_val_loss))
+
+            if register and best_params is not None:
+                # collective all-gather of any TP-sharded leaves, then host fetch
+                # on every process; only process 0 writes the registry
+                host_params = _fetch_to_host(best_params)
+                host_stats = _fetch_to_host(best_stats)
+                if is_main:
+                    variables = {"params": host_params}
+                    if host_stats:
+                        variables["batch_stats"] = host_stats
+                    registry_version = tracking.log_model(
+                        variables, model_cfg,
+                        registered_model_name=cfg.registered_model_name,
+                    )
+                    log.info(
+                        "registered %s version %s", cfg.registered_model_name,
+                        registry_version,
+                    )
+
+            run_id = run.info.run_id
+
+    finally:
+        ckpt.close()
     return TrainResult(
         run_id=run_id,
         registry_version=registry_version,

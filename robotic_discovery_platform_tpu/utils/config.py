@@ -87,6 +87,11 @@ class TrainConfig:
     # checkpoint every N epochs (the final epoch always saves); raise for
     # short-epoch runs where per-epoch state serialization dominates
     checkpoint_every: int = 1
+    # Overlap checkpoint IO with the next epoch's compute (single-process
+    # only; multi-host saves are collective and always synchronous): the
+    # state is snapshot on device, and a background worker pays the host
+    # fetch + disk write. False forces the synchronous save everywhere.
+    async_checkpointing: bool = True
     # TPU-first:
     donate_state: bool = True
     log_every: int = 1

@@ -120,6 +120,70 @@ def run_tp_resume_mode(workdir: str) -> dict:
     }
 
 
+def run_mesh3d_mode() -> dict:
+    """Full dp=2 x sp=2 x tp=2 mesh over a 4-PROCESS cluster (8 global
+    devices, 2 per host): one sharded train step through the real
+    ``parallel`` stack with batches placed by ``put_global_batch`` -- the
+    data axis (2) is SMALLER than the process count (4), so each data
+    shard spans two hosts and the old contiguous-row-block placement
+    cannot express it (round-3 verdict item 9). Each process also runs
+    the identical single-device step locally and reports both losses; the
+    parent asserts cross-host agreement AND mesh==single equivalence."""
+    import numpy as np
+    import optax
+
+    import jax
+
+    from robotic_discovery_platform_tpu.models import losses as losses_lib
+    from robotic_discovery_platform_tpu.models.unet import build_unet
+    from robotic_discovery_platform_tpu.parallel import dp
+    from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+    from robotic_discovery_platform_tpu.training import trainer
+    from robotic_discovery_platform_tpu.utils.config import (
+        MeshConfig,
+        ModelConfig,
+    )
+
+    # kept deliberately tiny (base 4, no eval compile): four processes
+    # compile concurrently on this 1-core CI host, and the point is the
+    # batch/sharding layout, not model capacity
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2, spatial=2, model=2))
+    model = build_unet(ModelConfig(base_features=4, compute_dtype="float32"))
+    tx = optax.adam(1e-3)
+    loss_fn = losses_lib.make_loss_fn("bce", 0.5)
+    state = trainer.create_state(model, tx, jax.random.key(0), 32)
+
+    rng = np.random.default_rng(0)
+    gx = rng.random((8, 32, 32, 3)).astype(np.float32)
+    gy = (rng.random((8, 32, 32, 1)) > 0.5).astype(np.float32)
+
+    # single-device reference on this host, same init/batch
+    ref_state = trainer.create_state(model, tx, jax.random.key(0), 32)
+    ref_step = trainer.make_train_step(model, tx, loss_fn, donate=False)
+    ref_state2, ref_loss = ref_step(ref_state, gx, gy)
+
+    train_step, _, state = dp.parallelize_training(
+        mesh, model, tx, loss_fn, state, donate=False, tp_min_channels=8
+    )
+    x = dp.put_global_batch(mesh, gx, spatial=True)
+    y = dp.put_global_batch(mesh, gy, spatial=True)
+    state, loss = train_step(state, x, y)
+    # one representative post-step param leaf, mesh vs single-device
+    leaf = jax.tree.leaves(state.params)[0]
+    ref_leaf = jax.tree.leaves(ref_state2.params)[0]
+    delta = float(np.max(np.abs(np.asarray(leaf) - np.asarray(ref_leaf))))
+
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("mesh3d done")
+    return {
+        "mesh": dict(mesh.shape),
+        "loss": float(loss),
+        "ref_loss": float(ref_loss),
+        "param_delta": delta,
+    }
+
+
 def main() -> None:
     coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "step"
@@ -146,6 +210,11 @@ def main() -> None:
     if mode in ("trainer", "tp_resume"):
         fn = run_trainer_mode if mode == "trainer" else run_tp_resume_mode
         out = fn(sys.argv[5])
+        out.update(pid=pid, processes=jax.process_count())
+        print(json.dumps(out), flush=True)
+        return
+    if mode == "mesh3d":
+        out = run_mesh3d_mode()
         out.update(pid=pid, processes=jax.process_count())
         print(json.dumps(out), flush=True)
         return

@@ -30,7 +30,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_cluster(extra_args=()):
+def _launch_cluster(extra_args=(), nproc: int = 2):
     coordinator = f"localhost:{_free_port()}"
     env = {
         k: v for k, v in os.environ.items()
@@ -38,11 +38,11 @@ def _launch_cluster(extra_args=()):
     }
     return [
         subprocess.Popen(
-            [sys.executable, str(WORKER), coordinator, "2", str(pid),
+            [sys.executable, str(WORKER), coordinator, str(nproc), str(pid),
              *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
         )
-        for pid in range(2)
+        for pid in range(nproc)
     ]
 
 
@@ -53,7 +53,9 @@ def _collect(procs):
     try:
         for p in procs:
             try:
-                out, err = p.communicate(timeout=600)
+                # generous: a 4-process cluster compiles 4 programs
+                # concurrently on this single-core CI host
+                out, err = p.communicate(timeout=900)
             except subprocess.TimeoutExpired:
                 p.kill()
                 out, err = p.communicate()
@@ -110,6 +112,29 @@ def test_two_process_train_model(tmp_path):
     # the store and checkpoints exist exactly once, under process 0's run
     assert (tmp_path / "mlruns").is_dir()
     assert (tmp_path / "ckpt").is_dir()
+
+
+@pytest.mark.slow
+def test_four_process_full_mesh_matches_single_device():
+    """A 4-PROCESS cluster carrying a dp=2 x sp=2 x tp=2 mesh (8 global
+    devices): the data axis is smaller than the process count, so each
+    data shard spans two hosts -- the layout-generality case of
+    ``put_global_batch`` (round-3 verdict item 9). Every host must agree
+    with every other AND with its own single-device reference step."""
+    procs = _launch_cluster(("mesh3d",), nproc=4)
+    outs = _collect(procs)
+    by_pid = {o["pid"]: o for o in outs}
+    assert set(by_pid) == {0, 1, 2, 3}
+    for o in outs:
+        assert o["processes"] == 4
+        assert o["mesh"] == {"data": 2, "spatial": 2, "model": 2}
+        # sharded step == the host's own single-device step (global-view
+        # pjit semantics; f32 reduction order is the only slack)
+        assert o["loss"] == pytest.approx(o["ref_loss"], rel=1e-5)
+        assert o["param_delta"] < 5e-3  # Adam near-zero-grad caveat
+    # and the replicated loss is identical across all four hosts
+    vals = [o["loss"] for o in outs]
+    assert max(vals) == pytest.approx(min(vals), rel=1e-6)
 
 
 @pytest.mark.slow

@@ -188,3 +188,33 @@ def test_import_registers_and_serves(tmp_path):
     np.testing.assert_allclose(
         np.asarray(got)[..., 0], want[:, 0], atol=2e-4, rtol=2e-4
     )
+
+
+def test_preprocess_matches_torchvision_resize():
+    """ops/pipeline.preprocess vs the reference's serving preprocess
+    (ToTensor -> Resize((256,256), antialias=True),
+    services/vision_analysis/server.py:107-110) on random uint8 frames --
+    the last unproven link in serving-path reference equivalence (round-3
+    verdict item 8).
+
+    torchvision is not installed in this image; its tensor Resize is a
+    thin wrapper over ``torch.nn.functional.interpolate(x, size,
+    mode="bilinear", align_corners=False, antialias=True)``
+    (torchvision/transforms/_functional_tensor.py ``resize``), which IS
+    available, so the oracle calls that directly. ToTensor is the /255 +
+    HWC->CHW part, applied inline.
+    """
+    from robotic_discovery_platform_tpu.ops import pipeline
+
+    rng = np.random.default_rng(7)
+    frames = rng.integers(0, 256, size=(3, 480, 640, 3), dtype=np.uint8)
+
+    # reference oracle: ToTensor + antialiased bilinear resize
+    t = torch.from_numpy(frames.transpose(0, 3, 1, 2)).float() / 255.0
+    want = torch.nn.functional.interpolate(
+        t, size=(256, 256), mode="bilinear", align_corners=False,
+        antialias=True,
+    ).numpy().transpose(0, 2, 3, 1)
+
+    got = np.asarray(pipeline.preprocess(jnp.asarray(frames), 256))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
