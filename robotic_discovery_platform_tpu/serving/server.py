@@ -46,21 +46,27 @@ log = get_logger(__name__)
 _resolve_warn_ts = [0.0]  # rate limit for the unreachable-registry warning
 
 
-def resolve_serving_version(cfg: ServerConfig) -> int | None:
+def resolve_serving_version(cfg: ServerConfig, store=None) -> int | None:
     """The registry version serving should run: the ``staging`` alias when
     set, else the latest version; None when the registry is empty or
     unreachable (callers decide whether that is fatal). Failures are
     logged (rate-limited to one per minute) so a silently-broken registry
-    doesn't make the hot-reload poller inert with zero diagnostics."""
+    doesn't make the hot-reload poller inert with zero diagnostics.
+
+    Uses a store SCOPED to ``cfg.tracking_uri`` (tracking.store_for):
+    the reload poller calls this from a background thread, and mutating
+    the process-global tracking URI from there would silently re-point
+    every other component's tracking mid-run. Callers that poll should
+    pass a cached ``store`` -- rebuilding an MLflow-backed store every
+    tick would churn clients and scratch dirs."""
     try:
-        tracking.set_tracking_uri(cfg.tracking_uri)
-        client = tracking.Client()
-        try:
-            return client.get_model_version_by_alias(
-                cfg.model_name, cfg.model_alias
-            ).version
-        except (KeyError, FileNotFoundError):
-            return client.get_latest_versions(cfg.model_name)[0].version
+        store = store if store is not None else tracking.store_for(
+            cfg.tracking_uri
+        )
+        version = store.get_alias(cfg.model_name, cfg.model_alias)
+        if version is not None:
+            return int(version)
+        return int(store.latest_version(cfg.model_name)["version"])
     except Exception as exc:
         now = time.monotonic()
         if now - _resolve_warn_ts[0] > 60.0:
@@ -120,6 +126,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self.geom_cfg = geom_cfg
         self.intrinsics = intrinsics
         self.depth_scale = depth_scale
+        # one scoped store for the reload poller's lifetime (thread-safe
+        # to build here; rebuilding per poll would churn MLflow clients
+        # and scratch dirs)
+        self._registry_store = tracking.store_for(cfg.tracking_uri)
         self._engine = self._make_engine(model, variables, version)
         self._warm_shape: tuple[int, int] | None = None
         self._reload_stop: threading.Event | None = None
@@ -304,11 +314,14 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
     def maybe_reload(self) -> bool:
         """One reload check; returns True when a new version was swapped in."""
-        version = resolve_serving_version(self.cfg)
+        version = resolve_serving_version(self.cfg, self._registry_store)
         if version is None or version == self._engine.version:
             return False
+        # scoped store: this runs on the poller thread (see
+        # resolve_serving_version's docstring)
         model, variables = tracking.load_model(
-            f"models:/{self.cfg.model_name}/{version}"
+            f"models:/{self.cfg.model_name}/{version}",
+            store=self._registry_store,
         )
         engine = self._make_engine(model, variables, version)
         if self._warm_shape is not None:
@@ -332,7 +345,9 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             # before tearing it down (stop() itself is drain-safe, so a
             # straggler past the grace window gets a per-frame error, not
             # a hang -- and per-frame errors don't drop the stream).
-            threading.Timer(10.0, old.dispatcher.stop).start()
+            threading.Timer(
+                self.cfg.reload_grace_s, old.dispatcher.stop
+            ).start()
         log.info("hot-reloaded model: version %s -> %s", old.version, version)
         return True
 

@@ -201,13 +201,25 @@ _MODEL_URI = re.compile(
 )
 
 
-def resolve_model_uri(uri: str) -> Path:
-    """models:/Name/latest | models:/Name/3 | models:/Name@staging -> path."""
+def store_for(tracking_uri: str):
+    """A store instance SCOPED to ``tracking_uri``, without touching the
+    process-global tracking state. Background threads (the serving
+    hot-reload poller) must use this: ``set_tracking_uri`` from a thread
+    would silently re-point every other component's tracking mid-run."""
+    return _make_store(tracking_uri)
+
+
+def resolve_model_uri(uri: str, store=None) -> Path:
+    """models:/Name/latest | models:/Name/3 | models:/Name@staging -> path.
+
+    ``store`` defaults to the process-global one; pass ``store_for(uri)``
+    for a scoped lookup.
+    """
     m = _MODEL_URI.match(uri)
     if not m:
         raise ValueError(f"unsupported model uri: {uri!r}")
     name = m.group("name")
-    store = _store()
+    store = _store() if store is None else store
     if m.group("alias"):
         version = store.get_alias(name, m.group("alias"))
         if version is None:
@@ -219,10 +231,10 @@ def resolve_model_uri(uri: str) -> Path:
     return store.version_path(name, version)
 
 
-def load_model(uri: str):
+def load_model(uri: str, store=None):
     """Load (model, variables) from a ``models:/`` uri or a plain path."""
     if uri.startswith("models:/"):
-        return load_model_dir(resolve_model_uri(uri))
+        return load_model_dir(resolve_model_uri(uri, store=store))
     return load_model_dir(Path(uri))
 
 

@@ -87,6 +87,20 @@ class CheckpointManager:
             raise FileNotFoundError("no checkpoint to restore")
         return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
 
-    def close(self) -> None:
-        self.wait()
-        self._mgr.close()
+    def close(self, raise_errors: bool = True) -> None:
+        """Drain any in-flight save and close the orbax manager (which is
+        closed even if the drain raises). ``raise_errors=False`` logs a
+        pending save failure instead of raising -- for cleanup paths that
+        must not mask an already-propagating exception."""
+        try:
+            self.wait()
+        except BaseException:
+            if raise_errors:
+                raise
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "async checkpoint save failed during close"
+            )
+        finally:
+            self._mgr.close()

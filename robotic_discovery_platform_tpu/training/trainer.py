@@ -450,10 +450,10 @@ def train_model(
     registry_version = None
     final_metrics: dict = {}
 
-    # close() in finally: an exception mid-training must still
-    # drain (or surface the failure of) any in-flight async save
-    # -- abandoning the daemon worker would silently lose the
-    # checkpoint it was writing
+    # close() on BOTH exits: an exception mid-training must still drain
+    # any in-flight async save (abandoning the daemon worker would
+    # silently lose the checkpoint it was writing) without masking the
+    # original error; the clean path surfaces save failures by raising
     try:
         with run_ctx as run:
             if is_main:
@@ -587,7 +587,12 @@ def train_model(
 
             run_id = run.info.run_id
 
-    finally:
+    except BaseException:
+        # close without raising: a pending save failure must not mask the
+        # already-propagating training exception (it is logged instead)
+        ckpt.close(raise_errors=False)
+        raise
+    else:
         ckpt.close()
     return TrainResult(
         run_id=run_id,

@@ -195,6 +195,9 @@ class ServerConfig:
     # streams (the reference requires a restart, SURVEY.md section 3.4).
     # <= 0 disables polling.
     reload_poll_s: float = 10.0
+    # After a hot-reload swap, how long the OLD engine's batch dispatcher
+    # stays alive for in-flight frames before its drain-safe teardown.
+    reload_grace_s: float = 10.0
 
 
 @dataclass(frozen=True)

@@ -46,11 +46,14 @@ def unet_forward_flops(img_size: int = 256, base: int = 64,
     x_ch = enc[-1]
     for skip, feat in zip(skips, feats):
         h2 = h * 2
-        # upsample_align_corners: einsum over H then W
-        # [h2,h]x[h,w,c] then [w2,w]x[h2,w,c] with w == h, w2 == h2
-        total += 2 * h2 * h * h * x_ch + 2 * h2 * h2 * h * x_ch
-        if not bilinear:
-            total += 2 * 4 * h2 * h2 * x_ch * (x_ch // 2)
+        if bilinear:
+            # upsample_align_corners: einsum over H then W
+            # [h2,h]x[h,w,c] then [w2,w]x[h2,w,c] with w == h, w2 == h2
+            total += 2 * h2 * h * h * x_ch + 2 * h2 * h2 * h * x_ch
+        else:
+            # 2x2 stride-2 transpose conv: each INPUT pixel spawns four
+            # taps, so the cost scales with the input's h*h
+            total += 2 * 4 * h * h * x_ch * (x_ch // 2)
         cat = x_ch + skip if bilinear else x_ch // 2 + skip
         # bilinear Up: mid_features = (x + skip concat) // 2 (models/unet.Up)
         mid = cat // 2 if bilinear else feat

@@ -222,20 +222,23 @@ def test_train_step_with_pallas_convs_matches_flax():
     assert max(jax.tree.leaves(deltas)) < 5e-3
 
 
-def test_analytic_flops_match_xla_cost_analysis():
+@pytest.mark.parametrize("bilinear", [True, False])
+def test_analytic_flops_match_xla_cost_analysis(bilinear):
     """The MFU accounting's conv-only FLOP count must agree with XLA's own
-    cost analysis of the full forward to ~15% (XLA additionally counts
-    elementwise/norm FLOPs but optimizes the interpolation einsums, so the
-    two counts straddle each other depending on scale; at the deployed
-    256^2/base-64 shape the measured ratio is 0.94)."""
+    cost analysis of the full forward to ~15% for BOTH decoder variants
+    (XLA additionally counts elementwise/norm FLOPs but optimizes the
+    interpolation einsums, so the two counts straddle each other
+    depending on scale; measured ratios: 0.94 bilinear at the deployed
+    256^2/base-64 shape, 0.92 non-bilinear at base 16)."""
     from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
     from robotic_discovery_platform_tpu.utils import flops as flops_lib
     from robotic_discovery_platform_tpu.utils.config import ModelConfig
 
-    m = build_unet(ModelConfig(base_features=16, compute_dtype="float32"))
+    m = build_unet(ModelConfig(base_features=16, compute_dtype="float32",
+                               bilinear=bilinear))
     v = init_unet(m, jax.random.key(0), 64)
     fn = jax.jit(lambda x: m.apply(v, x, train=False))
     cost = fn.lower(jnp.zeros((1, 64, 64, 3))).compile().cost_analysis()
     xla = cost["flops"] if isinstance(cost, dict) else cost[0]["flops"]
-    mine = flops_lib.unet_forward_flops(64, base=16)
+    mine = flops_lib.unet_forward_flops(64, base=16, bilinear=bilinear)
     assert 0.85 <= mine / xla <= 1.15, (mine, xla)

@@ -60,6 +60,7 @@ def running_server(registered_model, tmp_path):
     server.start()
     yield f"localhost:{port}", cfg, servicer
     server.stop(grace=None)
+    servicer.close()  # stops the reload poller; threads must not outlive
 
 
 def test_end_to_end_stream(running_server):
@@ -398,6 +399,84 @@ def test_hot_reload_mid_stream(tmp_path):
         assert responses[0].mask_coverage < 1.0
         assert responses[1].mask_coverage < 1.0
         assert responses[3].mask_coverage > 99.0
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+
+
+def test_hot_reload_with_batching_swaps_dispatcher(tmp_path):
+    """Hot-reload under micro-batching: the engine swap must build a NEW
+    dispatcher for the new variables and schedule the old one's teardown
+    without stranding frames (the dispatcher's drain-safe stop); frames
+    submitted after the swap run the new model."""
+    import copy
+
+    import jax
+    from flax.core import unfreeze
+
+    from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
+
+    uri = f"file:{tmp_path}/mlruns"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    base = unfreeze(jax.device_get(init_unet(model, jax.random.key(0), 64)))
+
+    def register(bias):
+        v = copy.deepcopy(base)
+        v["params"]["Conv_0"]["bias"] = np.full_like(
+            np.asarray(v["params"]["Conv_0"]["bias"]), bias
+        )
+        tracking.set_tracking_uri(uri)
+        with tracking.start_run():
+            ver = tracking.log_model(
+                v, mcfg, registered_model_name="Actuator-Segmenter"
+            )
+        tracking.Client().set_registered_model_alias(
+            "Actuator-Segmenter", "staging", ver
+        )
+        return ver
+
+    register(-10.0)
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        model_img_size=64,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        calibration_path=str(tmp_path / "missing.npz"),
+        batch_window_ms=5.0,
+        max_batch=2,
+        reload_poll_s=0.0,  # drive maybe_reload() directly
+    )
+    server, servicer = server_lib.build_server(cfg)
+    try:
+        old_dispatcher = servicer.dispatcher
+        assert old_dispatcher is not None
+        rgb = np.zeros((64, 64, 3), np.uint8)
+        depth = np.full((64, 64), 900, np.uint16)
+        k = server_lib._default_intrinsics(64, 64).astype(np.float32)
+        out1 = old_dispatcher.submit(rgb, depth, k, 0.001)
+        assert float(out1.mask_coverage) < 1.0  # bias -10 -> empty mask
+
+        v2 = register(10.0)
+        assert servicer.maybe_reload()
+        assert servicer.current_version == v2
+        new_dispatcher = servicer.dispatcher
+        assert new_dispatcher is not old_dispatcher
+        # the old dispatcher still serves an in-flight-style submit during
+        # the grace window rather than hanging or erroring (probe it FIRST:
+        # its graph is already compiled, so this stays well within the
+        # grace period even on a loaded CI host)
+        out3 = old_dispatcher.submit(rgb, depth, k, 0.001)
+        assert float(out3.mask_coverage) < 1.0
+        # new dispatcher serves the new model (pays its jit compile here)
+        out2 = new_dispatcher.submit(rgb, depth, k, 0.001)
+        assert float(out2.mask_coverage) > 99.0
+        # and once stopped (drain-safe), a late submit raises cleanly
+        old_dispatcher.stop()
+        with pytest.raises(RuntimeError, match="dispatcher stopped"):
+            old_dispatcher.submit(rgb, depth, k, 0.001)
     finally:
         server.stop(grace=None)
         servicer.close()
