@@ -480,3 +480,61 @@ def test_hot_reload_with_batching_swaps_dispatcher(tmp_path):
     finally:
         server.stop(grace=None)
         servicer.close()
+
+
+def test_reloader_does_not_touch_global_tracking(tmp_path):
+    """The hot-reload poller must use a store scoped to the server's own
+    tracking URI: set_tracking_uri from its background thread would
+    silently re-point every other component's tracking mid-run (the
+    cross-test registry pollution found in round 4). The test drives an
+    ACTUAL reload (resolve + load + swap on the poller thread) while the
+    process-global URI points elsewhere, and asserts it stayed there."""
+    import jax
+
+    from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
+
+    uri = f"file:{tmp_path}/mlruns"
+    prev_uri = tracking.get_tracking_uri()
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+
+    def register(seed):
+        tracking.set_tracking_uri(uri)
+        variables = init_unet(model, jax.random.key(seed), 64)
+        with tracking.start_run():
+            ver = tracking.log_model(
+                variables, mcfg, registered_model_name="Actuator-Segmenter"
+            )
+        tracking.Client().set_registered_model_alias(
+            "Actuator-Segmenter", "staging", ver
+        )
+        return ver
+
+    register(0)
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        model_img_size=64,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        calibration_path=str(tmp_path / "missing.npz"),
+        reload_poll_s=0.05,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    try:
+        v2 = register(1)  # forces the poller through the full reload path
+        elsewhere = f"file:{tmp_path}/unrelated_mlruns"
+        tracking.set_tracking_uri(elsewhere)
+        deadline = time.time() + 60.0
+        while (servicer.current_version != v2 and time.time() < deadline):
+            # the global URI must hold through every poll tick AND the
+            # reload itself
+            assert tracking.get_tracking_uri() == elsewhere
+            time.sleep(0.05)
+        assert servicer.current_version == v2
+        assert tracking.get_tracking_uri() == elsewhere
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+        tracking.set_tracking_uri(prev_uri)
