@@ -28,7 +28,10 @@ vs_target is always vs the 30 FPS north star.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -41,6 +44,85 @@ from jax import lax
 TARGET_FPS = 30.0  # BASELINE.json north star for serving on v5e-1
 CHAIN = 200
 
+# Wall-clock ceiling for the whole bench. The TPU on this image sits behind
+# a tunnel that can wedge mid-run (jax.devices() then blocks forever in C
+# land, unreachable by Python exception handling) -- when the deadline
+# fires we still emit the one structured JSON line the driver parses.
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "2400"))
+
+
+def _error_payload(kind: str, detail: str) -> dict:
+    return {
+        "metric": "fused_seg_curvature_fps_640x480_1chip",
+        "value": 0.0,
+        "unit": "frames/sec",
+        "vs_baseline": 0.0,
+        "vs_target": 0.0,
+        "error": kind,
+        "detail": detail[-800:],
+    }
+
+
+# exactly ONE result line (success or structured error) ever reaches
+# stdout: emit and deadline-fire race under one lock, and after the line is
+# out the deadline timer only force-exits (a teardown hang on the wedged
+# tunnel must still die) without printing a second, contradictory line
+_RESULT_PRINTED = threading.Event()
+_EMIT_LOCK = threading.Lock()
+
+
+def _emit_result(payload: dict) -> None:
+    with _EMIT_LOCK:
+        if _RESULT_PRINTED.is_set():
+            return
+        print(json.dumps(payload), flush=True)
+        _RESULT_PRINTED.set()
+
+
+def _arm_deadline() -> None:
+    def fire() -> None:
+        _emit_result(_error_payload(
+            "bench_deadline_exceeded",
+            f"no result after {DEADLINE_S:.0f}s "
+            "(accelerator tunnel likely wedged mid-run)",
+        ))
+        os._exit(0)
+
+    t = threading.Timer(DEADLINE_S, fire)
+    t.daemon = True
+    t.start()
+
+
+def _probe_backend(attempts: int = 3, timeout_s: float = 180.0) -> None:
+    """Prove the default backend can initialize AT ALL before this process
+    touches it. Backend bring-up on a wedged tunnel does not raise -- it
+    hangs indefinitely inside platform discovery (the round-4 BENCH
+    artifact) -- so the probe runs in a killable subprocess with a hard
+    timeout and bounded retries. Raises RuntimeError on terminal failure."""
+    last = ""
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; "
+                 "print(float(jnp.ones(()) + 1), jax.default_backend())"],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if proc.returncode == 0:
+                print(f"# backend probe ok: {proc.stdout.strip()}"
+                      f" (attempt {attempt + 1})", file=sys.stderr)
+                return
+            err_lines = proc.stderr.strip().splitlines() if proc.stderr else []
+            last = err_lines[-1] if err_lines else f"rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            last = f"backend init hung >{timeout_s:.0f}s (tunnel wedged?)"
+        except Exception as exc:  # noqa: BLE001 -- e.g. OSError spawning
+            last = f"{type(exc).__name__}: {exc}"
+        print(f"# backend probe attempt {attempt + 1}/{attempts} failed: "
+              f"{last}", file=sys.stderr)
+        time.sleep(10)
+    raise RuntimeError(f"backend unavailable after {attempts} probes: {last}")
+
 
 def _roundtrip_ms() -> float:
     """Median host->device->host latency for a trivial fetch."""
@@ -50,7 +132,17 @@ def _roundtrip_ms() -> float:
         return x + 1.0
 
     x = jnp.ones((8,))
-    float(trivial(x)[0])
+    # First device op in this process = backend bring-up; the tunneled
+    # backend intermittently drops the first connection even when healthy,
+    # so retry it with the same bounds as the compile path.
+    for attempt in range(4):
+        try:
+            float(trivial(x)[0])
+            break
+        except Exception:
+            if attempt == 3:
+                raise
+            time.sleep(5)
     ts = []
     for _ in range(10):
         t0 = time.perf_counter()
@@ -160,8 +252,6 @@ def main() -> None:
     pallas_fwd = (lambda x: pnet(x)) if pnet is not None else None
     # BENCH_TRACE_DIR=<dir> captures a jax.profiler trace of one fused chain
     # (TensorBoard-viewable) around the flax-forward measurement.
-    import os
-
     from robotic_discovery_platform_tpu.utils.profiling import jax_trace
 
     with jax_trace(os.environ.get("BENCH_TRACE_DIR")):
@@ -210,7 +300,7 @@ def main() -> None:
         except (KeyError, json.JSONDecodeError):
             baseline_fps = None
 
-    print(json.dumps({
+    _emit_result({
         "metric": "fused_seg_curvature_fps_640x480_1chip",
         "value": round(fps, 2),
         "unit": "frames/sec",
@@ -226,8 +316,24 @@ def main() -> None:
         },
         "baseline_src": ("measured_reference_cpu" if baseline_fps
                          else "design_target_30fps"),
-    }))
+    })
 
 
 if __name__ == "__main__":
-    main()
+    _arm_deadline()
+    try:
+        _probe_backend()
+    except Exception as e:  # noqa: BLE001 -- any probe failure is terminal
+        # Terminal backend failure: one parseable JSON line, clean exit --
+        # never a bare traceback (round-4's rc=1 artifact was unparseable).
+        _emit_result(_error_payload("tpu_unavailable", str(e)))
+        sys.exit(0)
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 -- structured artifact by design
+        import traceback
+
+        traceback.print_exc()
+        _emit_result(_error_payload(
+            "bench_error", f"{type(e).__name__}: {e}"))
+        sys.exit(0)

@@ -134,6 +134,16 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self._warm_shape: tuple[int, int] | None = None
         self._reload_stop: threading.Event | None = None
         self._reload_thread: threading.Thread | None = None
+        # at most one reload in flight: the poller thread and direct
+        # callers (tests, admin hooks) must not interleave two
+        # resolve/build/swap sequences -- unserialized, engines could swap
+        # in arbitrary order and a generation's dispatcher could miss its
+        # scheduled stop
+        self._reload_lock = threading.Lock()
+        self._closed = False
+        # pending grace-delayed (timer, old_dispatcher) teardowns; close()
+        # cancels the timers and stops the dispatchers immediately
+        self._grace_stops: list[tuple[threading.Timer, Any]] = []
         self.metrics = metrics or MetricsWriter(
             cfg.metrics_csv, cfg.metrics_flush_every
         )
@@ -314,22 +324,72 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
     def maybe_reload(self) -> bool:
         """One reload check; returns True when a new version was swapped in."""
-        version = resolve_serving_version(self.cfg, self._registry_store)
-        if version is None or version == self._engine.version:
-            return False
-        # scoped store: this runs on the poller thread (see
-        # resolve_serving_version's docstring)
-        model, variables = tracking.load_model(
-            f"models:/{self.cfg.model_name}/{version}",
-            store=self._registry_store,
-        )
-        engine = self._make_engine(model, variables, version)
-        if self._warm_shape is not None:
-            # compile + run once off the serving path so in-flight streams
-            # never pay the new graph's XLA compilation
-            w, h = self._warm_shape
-            k = (self.intrinsics if self.intrinsics is not None
-                 else _default_intrinsics(w, h))
+        with self._reload_lock:
+            if self._closed:
+                return False
+            version = resolve_serving_version(self.cfg, self._registry_store)
+            if version is None or version == self._engine.version:
+                return False
+            # scoped store: this runs on the poller thread (see
+            # resolve_serving_version's docstring)
+            model, variables = tracking.load_model(
+                f"models:/{self.cfg.model_name}/{version}",
+                store=self._registry_store,
+            )
+            engine = self._make_engine(model, variables, version)
+            try:
+                # compile + run every graph live frames will hit, off the
+                # serving path, so in-flight streams never pay the new
+                # generation's XLA compilation -- including the dispatcher's
+                # per-bucket batched graphs when micro-batching is on
+                self._warm_engine(engine)
+            except Exception:
+                # the engine never went live: tear down its dispatcher
+                # (whose collector thread started in _make_engine) so a
+                # repeatedly-failing promotion can't leak one thread plus
+                # its compiled graphs per poll tick
+                if engine.dispatcher is not None:
+                    engine.dispatcher.stop()
+                raise
+            if self._closed:
+                # close() ran while we were compiling: never swap a new
+                # generation into a closed service
+                if engine.dispatcher is not None:
+                    engine.dispatcher.stop()
+                return False
+            old, self._engine = self._engine, engine
+            if old.dispatcher is not None:
+                # Grace-delayed stop: a frame thread that read the OLD
+                # engine just before the swap may still be about to
+                # submit(); give in-flight frames ample time to finish on
+                # the old dispatcher before tearing it down (stop() itself
+                # is drain-safe, so a straggler past the grace window gets
+                # a per-frame error, not a hang -- and per-frame errors
+                # don't drop the stream).
+                t = threading.Timer(
+                    self.cfg.reload_grace_s, old.dispatcher.stop
+                )
+                t.daemon = True
+                self._grace_stops = [
+                    (tm, d) for tm, d in self._grace_stops if tm.is_alive()
+                ]
+                self._grace_stops.append((t, old.dispatcher))
+                t.start()
+            log.info("hot-reloaded model: version %s -> %s",
+                     old.version, version)
+            return True
+
+    def _warm_engine(self, engine: Engine) -> None:
+        """Pre-compile the graphs live frames will actually dispatch to on
+        ``engine``: the batched per-bucket graphs when it carries a
+        dispatcher (the path every frame takes then), the single-frame
+        analyze otherwise. No-op until warmup() records a camera shape."""
+        if self._warm_shape is None:
+            return
+        w, h = self._warm_shape
+        k = (self.intrinsics if self.intrinsics is not None
+             else _default_intrinsics(w, h))
+        if engine.dispatcher is None:
             engine.analyze(
                 engine.variables,
                 np.zeros((h, w, 3), np.uint8),
@@ -337,19 +397,23 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 np.asarray(k, np.float32),
                 np.float32(self.depth_scale),
             )
-        old, self._engine = self._engine, engine
-        if old.dispatcher is not None:
-            # Grace-delayed stop: a frame thread that read the OLD engine
-            # just before the swap may still be about to submit(); give
-            # in-flight frames ample time to finish on the old dispatcher
-            # before tearing it down (stop() itself is drain-safe, so a
-            # straggler past the grace window gets a per-frame error, not
-            # a hang -- and per-frame errors don't drop the stream).
-            threading.Timer(
-                self.cfg.reload_grace_s, old.dispatcher.stop
-            ).start()
-        log.info("hot-reloaded model: version %s -> %s", old.version, version)
-        return True
+            return
+        # the dispatcher pads each dispatch to min(next_pow2(n), max_batch),
+        # so the reachable bucket sizes are the powers of two below
+        # max_batch plus max_batch itself (which is the top bucket even
+        # when it is not a power of two)
+        sizes, b = [], 1
+        while b < self.cfg.max_batch:
+            sizes.append(b)
+            b *= 2
+        sizes.append(self.cfg.max_batch)
+        for b in sizes:
+            engine.dispatcher._analyze(
+                np.zeros((b, h, w, 3), np.uint8),
+                np.zeros((b, h, w), np.uint16),
+                np.repeat(np.asarray(k, np.float32)[None], b, 0),
+                np.full((b,), self.depth_scale, np.float32),
+            )
 
     def warmup(self, width: int, height: int) -> None:
         """Pre-compile the fused graph for a camera geometry so the first
@@ -368,33 +432,42 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                                          height=height),
         )
         color, depth = self._decode(req)
+        # exercise the real per-frame path once (decode included), then
+        # pre-compile every graph a load burst could hit (single-frame or
+        # per-bucket batched -- shared with the hot-reload warm). Under the
+        # reload lock: otherwise a poll tick that read _warm_shape as None
+        # could swap in a never-warmed engine while we warm the old one.
         self._analyze_frame(color, depth)
-        dispatcher = self._engine.dispatcher
-        if dispatcher is not None:
-            # pre-compile every micro-batch bucket so a load burst does not
-            # pay XLA compilation mid-stream
-            k = (self.intrinsics if self.intrinsics is not None
-                 else _default_intrinsics(width, height))
-            b = 1
-            while b <= self.cfg.max_batch:
-                dispatcher._analyze(
-                    np.zeros((b, height, width, 3), np.uint8),
-                    np.zeros((b, height, width), np.uint16),
-                    np.repeat(np.asarray(k, np.float32)[None], b, 0),
-                    np.full((b,), self.depth_scale, np.float32),
-                )
-                b *= 2
+        with self._reload_lock:
+            self._warm_engine(self._engine)
         log.info("warmed up %dx%d analyzer on %s", width, height,
                  jax.default_backend())
 
     def close(self) -> None:
+        # flag first: an in-flight reload re-checks it before swapping, so
+        # a generation built after this point never goes live
+        self._closed = True
         if self._reload_stop is not None:
             self._reload_stop.set()
         if self._reload_thread is not None:
             self._reload_thread.join(timeout=5)
             self._reload_thread = None
-        if self._engine.dispatcher is not None:
-            self._engine.dispatcher.stop()
+        # flush pending grace-delayed teardowns NOW: cancel each timer and
+        # stop its dispatcher immediately (stop() is drain-safe and
+        # idempotent, so racing an already-fired timer is harmless) --
+        # otherwise a close() shortly after a reload would leave a live
+        # non-daemon timer blocking interpreter exit for reload_grace_s.
+        # Taking the reload lock here also means a reload the 5s join did
+        # not outwait has fully finished (and self-cleaned, per the flag)
+        # before we read _grace_stops and the final engine.
+        with self._reload_lock:
+            pending, self._grace_stops = self._grace_stops, []
+            engine = self._engine
+        for timer, dispatcher in pending:
+            timer.cancel()
+            dispatcher.stop()
+        if engine.dispatcher is not None:
+            engine.dispatcher.stop()
         self.metrics.flush()
 
 

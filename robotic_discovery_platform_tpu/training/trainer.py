@@ -254,8 +254,20 @@ def train_model(
         if not np.issubdtype(xs.dtype, np.floating):
             xs = np.asarray(xs, np.float32) / 255.0
         if not np.issubdtype(ys.dtype, np.floating):
-            scale = 255.0 if np.max(ys, initial=0) > 1 else 1.0
-            ys = np.asarray(ys, np.float32) / scale
+            if np.max(ys, initial=0) > 1:
+                # only the file loader's 0/255 coding gets the /255 path;
+                # any other integer coding (class indices {0,2}, 0..K
+                # multi-class labels) would silently become ~K/255 targets,
+                # so reject it loudly instead of training against noise
+                values = np.unique(ys)
+                if not np.isin(values, (0, 255)).all():
+                    raise ValueError(
+                        "integer masks must be coded {0,1} or {0,255}; got "
+                        f"values {values[:8].tolist()}"
+                    )
+                ys = np.asarray(ys, np.float32) / 255.0
+            else:
+                ys = np.asarray(ys, np.float32)
         n_samples = len(xs)
         ds = None
     else:

@@ -1,0 +1,60 @@
+"""JAX platform pinning for hermetic test / driver / subprocess entries.
+
+This image's axon sitecustomize force-registers a tunneled TPU backend and
+rewrites ``jax_platforms`` at interpreter start, and entering that backend's
+platform discovery with the tunnel wedged HANGS (it does not raise) -- which
+is how round 4's driver artifacts were lost. The working idiom, shared by
+every entry that must never touch the accelerator, is:
+
+- set ``JAX_PLATFORMS`` in the environment (so child processes inherit it),
+- make sure ``XLA_FLAGS`` forces enough virtual CPU devices (XLA reads the
+  flag when the CPU client is first created, which is lazy -- setting it
+  after ``import jax`` but before the first device query still works),
+- AND re-apply the platform through ``jax.config.update`` (the env var
+  alone does not survive the sitecustomize rewrite).
+
+Keep this the single home of that idiom: tests/conftest.py,
+tests/multihost_worker.py, __graft_entry__.dryrun_multichip and
+training/supervisor's child entry all route through here.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_platform(min_devices: int = 8) -> None:
+    """Pin this process (and its future children) to ``min_devices`` virtual
+    CPU devices; never enters accelerator platform discovery."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    match = re.search(
+        r"--xla_force_host_platform_device_count=(\d+)", flags
+    )
+    if match is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={min_devices}"
+        ).strip()
+    elif int(match.group(1)) < min_devices:
+        # an inherited smaller count (e.g. from a multihost worker env)
+        # must be RAISED, not silently kept -- the caller needs min_devices
+        os.environ["XLA_FLAGS"] = (
+            flags[: match.start()]
+            + f"--xla_force_host_platform_device_count={min_devices}"
+            + flags[match.end():]
+        )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def apply_env_platform() -> None:
+    """Honor an inherited ``JAX_PLATFORMS`` pin in a child process: re-apply
+    it through the config so the sitecustomize rewrite cannot undo it. No-op
+    when the env var is unset (the child keeps default platform selection)."""
+    platform = os.environ.get("JAX_PLATFORMS")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

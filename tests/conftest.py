@@ -9,19 +9,13 @@ backend and rewrites ``jax_platforms`` at interpreter start, so the env var
 alone is not enough -- we also update the config after importing jax.
 """
 
-import os
+from robotic_discovery_platform_tpu.utils.platforms import force_cpu_platform
 
-# Must run before the first `import jax` anywhere in the test session.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Must run before the first device query anywhere in the test session.
+force_cpu_platform(min_devices=8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.default_backend()
 
 import numpy as np  # noqa: E402

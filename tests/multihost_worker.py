@@ -188,18 +188,15 @@ def main() -> None:
     coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "step"
 
-    # Same virtual-CPU-backend forcing as tests/conftest.py (the axon
-    # sitecustomize re-registers the TPU backend at interpreter start).
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=2"
-        ).strip()
+    # Same virtual-CPU-backend forcing as tests/conftest.py (see
+    # utils/platforms.py); 2 virtual devices per worker process.
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        force_cpu_platform,
+    )
+
+    force_cpu_platform(min_devices=2)
 
     import jax
-
-    jax.config.update("jax_platforms", "cpu")
 
     from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
 

@@ -482,6 +482,93 @@ def test_hot_reload_with_batching_swaps_dispatcher(tmp_path):
         servicer.close()
 
 
+def test_reload_grace_timer_does_not_block_close(tmp_path):
+    """close() shortly after a reload must cancel the pending grace-delayed
+    teardown and stop the old dispatcher immediately -- not block interpreter
+    exit for reload_grace_s, or fire the timer against torn-down state
+    (round-4 advice). Also covers the reload serialization lock: concurrent
+    maybe_reload() calls produce exactly ONE swap."""
+    import copy
+    import threading
+    import time as time_lib
+
+    import jax
+    from flax.core import unfreeze
+
+    from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
+
+    uri = f"file:{tmp_path}/mlruns"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    base = unfreeze(jax.device_get(init_unet(model, jax.random.key(0), 64)))
+
+    def register(bias):
+        v = copy.deepcopy(base)
+        v["params"]["Conv_0"]["bias"] = np.full_like(
+            np.asarray(v["params"]["Conv_0"]["bias"]), bias
+        )
+        tracking.set_tracking_uri(uri)
+        with tracking.start_run():
+            ver = tracking.log_model(
+                v, mcfg, registered_model_name="Actuator-Segmenter"
+            )
+        tracking.Client().set_registered_model_alias(
+            "Actuator-Segmenter", "staging", ver
+        )
+        return ver
+
+    register(-10.0)
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        model_img_size=64,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        calibration_path=str(tmp_path / "missing.npz"),
+        batch_window_ms=5.0,
+        max_batch=2,
+        reload_poll_s=0.0,
+        reload_grace_s=30.0,  # long grace: close() must not wait it out
+    )
+    server, servicer = server_lib.build_server(cfg)
+    try:
+        # record a warm shape so the reload pre-compiles the new
+        # dispatcher's batched buckets off the serving path
+        servicer.warmup(64, 64)
+        old_dispatcher = servicer.dispatcher
+        register(10.0)
+        # concurrent reload attempts: the lock serializes them into exactly
+        # one engine swap
+        swaps = []
+        threads = [
+            threading.Thread(
+                target=lambda: swaps.append(servicer.maybe_reload())
+            )
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(swaps) == 1
+        assert servicer._grace_stops  # teardown scheduled, not yet fired
+        # new engine's batched graph was pre-warmed and serves immediately
+        rgb = np.zeros((64, 64, 3), np.uint8)
+        depth = np.full((64, 64), 900, np.uint16)
+        k = server_lib._default_intrinsics(64, 64).astype(np.float32)
+        out = servicer.dispatcher.submit(rgb, depth, k, 0.001)
+        assert float(out.mask_coverage) > 99.0
+    finally:
+        server.stop(grace=None)
+        t0 = time_lib.perf_counter()
+        servicer.close()
+        closed_in = time_lib.perf_counter() - t0
+    assert closed_in < 10.0, closed_in  # not reload_grace_s
+    with pytest.raises(RuntimeError, match="dispatcher stopped"):
+        old_dispatcher.submit(rgb, depth, k, 0.001)
+
+
 def test_reloader_does_not_touch_global_tracking(tmp_path):
     """The hot-reload poller must use a store scoped to the server's own
     tracking URI: set_tracking_uri from its background thread would

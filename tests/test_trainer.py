@@ -52,6 +52,26 @@ def test_train_registers_and_tracks(tmp_path, arrays):
     assert "miou" in res.final_metrics
 
 
+def test_integer_masks_0_255_normalized_other_codings_rejected(tmp_path):
+    """In-memory integer masks follow the file loader's convention: {0,255}
+    is scaled to {0,1}, {0,1} passes through, and any other coding (class
+    indices like {0,2}) is rejected loudly instead of being silently scaled
+    to ~K/255 near-zero targets (round-4 advice)."""
+    imgs, masks = synthetic.generate_arrays(8, 32, 32, seed=3)
+    cfg = tiny_cfg(tmp_path, epochs=1)
+    # uint8 images + 0/255 masks train fine (the /255 path)
+    res = trainer.train_model(
+        cfg, TINY_MODEL, arrays=(imgs, masks), register=False
+    )
+    assert np.isfinite(res.best_val_loss)
+    bad = (masks > 0).astype(np.uint8) * 2  # {0, 2} class coding
+    with pytest.raises(ValueError, match="integer masks"):
+        trainer.train_model(
+            tiny_cfg(tmp_path, epochs=1), TINY_MODEL,
+            arrays=(imgs, bad), register=False,
+        )
+
+
 def test_loss_decreases(tmp_path, arrays):
     cfg = tiny_cfg(tmp_path, epochs=5)
     res = trainer.train_model(cfg, TINY_MODEL, arrays=arrays, register=False)
