@@ -66,17 +66,19 @@ def _error_payload(kind: str, detail: str) -> dict:
 # exactly ONE result line (success or structured error) ever reaches
 # stdout: emit and deadline-fire race under one lock, and after the line is
 # out the deadline timer only force-exits (a teardown hang on the wedged
-# tunnel must still die) without printing a second, contradictory line
-_RESULT_PRINTED = threading.Event()
+# tunnel must still die) without printing a second, contradictory line.
+# Plain bool under the lock -- nothing ever *waits* on this state.
+_result_printed = False
 _EMIT_LOCK = threading.Lock()
 
 
 def _emit_result(payload: dict) -> None:
+    global _result_printed
     with _EMIT_LOCK:
-        if _RESULT_PRINTED.is_set():
+        if _result_printed:
             return
         print(json.dumps(payload), flush=True)
-        _RESULT_PRINTED.set()
+        _result_printed = True
 
 
 def _arm_deadline() -> None:
@@ -120,7 +122,8 @@ def _probe_backend(attempts: int = 3, timeout_s: float = 180.0) -> None:
             last = f"{type(exc).__name__}: {exc}"
         print(f"# backend probe attempt {attempt + 1}/{attempts} failed: "
               f"{last}", file=sys.stderr)
-        time.sleep(10)
+        if attempt < attempts - 1:
+            time.sleep(10)
     raise RuntimeError(f"backend unavailable after {attempts} probes: {last}")
 
 

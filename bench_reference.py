@@ -39,9 +39,11 @@ sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(REPO / "tests"))
 
 
-def build_torch_unet():
+def build_torch_unet(base_features: int = 64):
     """Reference-equivalent torch model from the SURVEY spec (bilinear
-    variant, the deployed configuration)."""
+    variant, the deployed configuration at the default width; smaller
+    ``base_features`` keeps the same ladder shape for fast/committable
+    parity fixtures)."""
     import torch
     import torch.nn as nn
 
@@ -86,7 +88,7 @@ def build_torch_unet():
     class UNet(nn.Module):
         def __init__(self, n_channels=3, n_classes=1):
             super().__init__()
-            f = 64
+            f = base_features
             self.inc = DoubleConv(n_channels, f)
             self.down1 = Down(f, f * 2)
             self.down2 = Down(f * 2, f * 4)

@@ -140,6 +140,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # in arbitrary order and a generation's dispatcher could miss its
         # scheduled stop
         self._reload_lock = threading.Lock()
+        self._reload_busy = False
         self._closed = False
         # pending grace-delayed (timer, old_dispatcher) teardowns; close()
         # cancels the timers and stops the dispatchers immediately
@@ -323,12 +324,23 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self._reload_thread.start()
 
     def maybe_reload(self) -> bool:
-        """One reload check; returns True when a new version was swapped in."""
+        """One reload check; returns True when a new version was swapped in.
+
+        The expensive phase (registry resolve, model load, engine build,
+        XLA warm) runs OUTSIDE ``_reload_lock``, guarded by a busy flag so
+        at most one reload is ever in flight; the lock is held only for the
+        engine swap. close() and warmup() therefore block at most for a
+        swap, never for a compile (review finding: a SIGTERM mid-reload
+        must not stall shutdown for a full warm)."""
         with self._reload_lock:
-            if self._closed:
+            if self._closed or self._reload_busy:
                 return False
+            self._reload_busy = True
+            current_version = self._engine.version
+        engine = None
+        try:
             version = resolve_serving_version(self.cfg, self._registry_store)
-            if version is None or version == self._engine.version:
+            if version is None or version == current_version:
                 return False
             # scoped store: this runs on the poller thread (see
             # resolve_serving_version's docstring)
@@ -337,56 +349,77 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 store=self._registry_store,
             )
             engine = self._make_engine(model, variables, version)
-            try:
-                # compile + run every graph live frames will hit, off the
-                # serving path, so in-flight streams never pay the new
-                # generation's XLA compilation -- including the dispatcher's
-                # per-bucket batched graphs when micro-batching is on
-                self._warm_engine(engine)
-            except Exception:
-                # the engine never went live: tear down its dispatcher
-                # (whose collector thread started in _make_engine) so a
-                # repeatedly-failing promotion can't leak one thread plus
-                # its compiled graphs per poll tick
-                if engine.dispatcher is not None:
-                    engine.dispatcher.stop()
-                raise
             if self._closed:
-                # close() ran while we were compiling: never swap a new
-                # generation into a closed service
-                if engine.dispatcher is not None:
-                    engine.dispatcher.stop()
-                return False
-            old, self._engine = self._engine, engine
-            if old.dispatcher is not None:
-                # Grace-delayed stop: a frame thread that read the OLD
-                # engine just before the swap may still be about to
-                # submit(); give in-flight frames ample time to finish on
-                # the old dispatcher before tearing it down (stop() itself
-                # is drain-safe, so a straggler past the grace window gets
-                # a per-frame error, not a hang -- and per-frame errors
-                # don't drop the stream).
-                t = threading.Timer(
-                    self.cfg.reload_grace_s, old.dispatcher.stop
-                )
-                t.daemon = True
-                self._grace_stops = [
-                    (tm, d) for tm, d in self._grace_stops if tm.is_alive()
-                ]
-                self._grace_stops.append((t, old.dispatcher))
-                t.start()
+                return False  # skip the warm entirely; finally cleans up
+            # compile + run every graph live frames will hit, off the
+            # serving path, so in-flight streams never pay the new
+            # generation's XLA compilation -- including the dispatcher's
+            # per-bucket batched graphs when micro-batching is on.
+            # Snapshot-and-recheck: a concurrent warmup() can record a NEW
+            # camera shape while we warm for the old one (or for none);
+            # the swap below only proceeds once the engine is warm for the
+            # shape that is current at swap time, else we re-warm.
+            old = None
+            warmed_shape = object()  # sentinel: warmed for nothing yet
+            while True:
+                shape = self._warm_shape
+                if shape is not None and shape != warmed_shape:
+                    self._warm_engine(engine, shape)
+                warmed_shape = shape
+                with self._reload_lock:
+                    if self._closed:
+                        return False  # never swap into a closed service
+                    if (self._warm_shape is not None
+                            and self._warm_shape != warmed_shape):
+                        continue  # warmup() raced us; warm the new shape
+                    old, self._engine = self._engine, engine
+                    engine = None  # went live; finally must not stop it
+                    if old.dispatcher is not None:
+                        # Grace-delayed stop: a frame thread that read the
+                        # OLD engine just before the swap may still be
+                        # about to submit(); give in-flight frames ample
+                        # time to finish on the old dispatcher before
+                        # tearing it down (stop() itself is drain-safe, so
+                        # a straggler past the grace window gets a
+                        # per-frame error, not a hang -- and per-frame
+                        # errors don't drop the stream).
+                        t = threading.Timer(
+                            self.cfg.reload_grace_s, old.dispatcher.stop
+                        )
+                        t.daemon = True
+                        self._grace_stops = [
+                            (tm, d) for tm, d in self._grace_stops
+                            if tm.is_alive()
+                        ]
+                        self._grace_stops.append((t, old.dispatcher))
+                        t.start()
+                    break
             log.info("hot-reloaded model: version %s -> %s",
                      old.version, version)
             return True
+        finally:
+            # never went live (error, closed mid-build/-warm, or the swap
+            # was refused): tear down its dispatcher (whose collector
+            # thread started in _make_engine) so a repeatedly-failing
+            # promotion can't leak one thread plus its compiled graphs per
+            # poll tick
+            if engine is not None and engine.dispatcher is not None:
+                engine.dispatcher.stop()
+            with self._reload_lock:
+                self._reload_busy = False
 
-    def _warm_engine(self, engine: Engine) -> None:
+    def _warm_engine(self, engine: Engine,
+                     shape: tuple[int, int] | None = None) -> None:
         """Pre-compile the graphs live frames will actually dispatch to on
         ``engine``: the batched per-bucket graphs when it carries a
         dispatcher (the path every frame takes then), the single-frame
-        analyze otherwise. No-op until warmup() records a camera shape."""
-        if self._warm_shape is None:
+        analyze otherwise. ``shape`` pins the camera (w, h) explicitly
+        (reload's snapshot-and-recheck needs that); defaults to the shape
+        warmup() recorded, a no-op when there is none yet."""
+        shape = shape if shape is not None else self._warm_shape
+        if shape is None:
             return
-        w, h = self._warm_shape
+        w, h = shape
         k = (self.intrinsics if self.intrinsics is not None
              else _default_intrinsics(w, h))
         if engine.dispatcher is None:
@@ -456,10 +489,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # stop its dispatcher immediately (stop() is drain-safe and
         # idempotent, so racing an already-fired timer is harmless) --
         # otherwise a close() shortly after a reload would leave a live
-        # non-daemon timer blocking interpreter exit for reload_grace_s.
-        # Taking the reload lock here also means a reload the 5s join did
-        # not outwait has fully finished (and self-cleaned, per the flag)
-        # before we read _grace_stops and the final engine.
+        # timer firing against torn-down state. An in-flight reload is NOT
+        # waited for: its swap re-checks _closed under this same lock, so
+        # any swap serialized after this drain is refused and the reload's
+        # finally-block stops the never-live dispatcher itself.
         with self._reload_lock:
             pending, self._grace_stops = self._grace_stops, []
             engine = self._engine

@@ -55,15 +55,31 @@ def get_tracking_uri() -> str:
 
 def _make_store(uri: str):
     """URI-scheme backend selection: the dependency-free FileStore by
-    default, the real-MLflow adapter for server/databricks URIs or any URI
-    prefixed ``mlflow+`` (see tracking/mlflow_backend.py)."""
+    default; for tracking-server URIs, the mlflow-client adapter
+    (tracking/mlflow_backend.py) when the ``mlflow`` extra is installed,
+    else the dependency-free REST client (tracking/rest_backend.py).
+    ``mlflow+<uri>`` forces the client adapter, ``mlflow-rest+http(s)://``
+    forces the REST client."""
     scheme = uri.split(":", 1)[0]
-    if scheme in ("http", "https") or uri.startswith(("databricks", "mlflow+")):
-        from robotic_discovery_platform_tpu.tracking.mlflow_backend import (
-            MlflowStore)
+    if uri.startswith("mlflow-rest+"):
+        from robotic_discovery_platform_tpu.tracking.rest_backend import (
+            RestMlflowStore)
 
-        return MlflowStore(uri[len("mlflow+"):] if uri.startswith("mlflow+")
-                           else uri)
+        return RestMlflowStore(uri[len("mlflow-rest+"):])
+    if scheme in ("http", "https") or uri.startswith(("databricks", "mlflow+")):
+        bare = uri[len("mlflow+"):] if uri.startswith("mlflow+") else uri
+        try:
+            from robotic_discovery_platform_tpu.tracking.mlflow_backend import (
+                MlflowStore)
+
+            return MlflowStore(bare)
+        except ImportError:
+            if scheme not in ("http", "https"):
+                raise  # databricks/mlflow+file etc. need the real client
+            from robotic_discovery_platform_tpu.tracking.rest_backend import (
+                RestMlflowStore)
+
+            return RestMlflowStore(bare)
     return FileStore(uri)
 
 

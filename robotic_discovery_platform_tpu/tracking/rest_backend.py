@@ -1,0 +1,300 @@
+"""Dependency-free MLflow REST tracking/registry backend.
+
+The reference's deployments run a real MLflow *server* (reference:
+scripts/train_segmenter.py:33,112-129 -- ``mlflow ui`` browses the same
+store). tracking/mlflow_backend.py adapts to that via the ``mlflow`` client
+package, but that package is an optional extra; this module speaks MLflow's
+documented REST surface directly over HTTP (``/api/2.0/mlflow/...`` plus the
+``mlflow-artifacts`` proxy a ``mlflow server --serve-artifacts`` deployment
+exposes), so a framework process can log to / load from a genuine MLflow
+tracking server with no mlflow dependency at all.
+
+Backend selection (tracking/api._make_store): ``http(s)://`` URIs prefer the
+mlflow-client adapter when the package is importable and fall back to this
+store otherwise; ``mlflow-rest+http(s)://`` forces this store.
+
+Protocol parity: every method mirrors FileStore/MlflowStore (store.py /
+mlflow_backend.py) -- the contract tests drive all three through the same
+surface, and tests/fake_mlflow_server.py exercises this one over a real
+socket.
+"""
+
+from __future__ import annotations
+
+import posixpath
+import tempfile
+import time
+from pathlib import Path
+
+import requests
+
+_API = "/api/2.0/mlflow"
+_ARTIFACTS = "/api/2.0/mlflow-artifacts/artifacts"
+
+
+class MlflowRestError(RuntimeError):
+    """An MLflow REST call failed; carries the server's error_code."""
+
+    def __init__(self, status: int, error_code: str, message: str):
+        super().__init__(f"{error_code} (HTTP {status}): {message}")
+        self.status = status
+        self.error_code = error_code
+
+
+class RestMlflowStore:
+    """FileStore-protocol adapter speaking MLflow's REST API directly."""
+
+    def __init__(self, uri: str, timeout_s: float = 30.0):
+        self.uri = uri.rstrip("/")
+        self.timeout_s = timeout_s
+        self._http = requests.Session()
+        self._make_scratch()
+
+    def _make_scratch(self) -> None:
+        import shutil
+        import weakref
+
+        self._scratch = Path(tempfile.mkdtemp(prefix="rdp-mlflow-rest-"))
+        self._cleanup = weakref.finalize(
+            self, shutil.rmtree, str(self._scratch), True
+        )
+
+    def _ensure_scratch(self) -> Path:
+        # same lazy-recreate semantics as MlflowStore._ensure_scratch
+        if not self._scratch.exists():
+            self._make_scratch()
+        return self._scratch
+
+    def close(self) -> None:
+        """Remove the artifact staging scratch directory; the store remains
+        usable (scratch is lazily recreated)."""
+        self._cleanup()
+        self._http.close()
+
+    # -- transport ----------------------------------------------------------
+
+    def _call(self, method: str, endpoint: str, *, params=None, body=None):
+        resp = self._http.request(
+            method, f"{self.uri}{_API}/{endpoint}", params=params,
+            json=body, timeout=self.timeout_s,
+        )
+        if resp.status_code >= 400:
+            try:
+                err = resp.json()
+            except ValueError:
+                err = {}
+            raise MlflowRestError(
+                resp.status_code,
+                err.get("error_code", "INTERNAL_ERROR"),
+                err.get("message", resp.text[:200]),
+            )
+        return resp.json() if resp.content else {}
+
+    # -- experiments / runs -------------------------------------------------
+
+    def get_or_create_experiment(self, name: str) -> str:
+        try:
+            out = self._call("GET", "experiments/get-by-name",
+                             params={"experiment_name": name})
+            return out["experiment"]["experiment_id"]
+        except MlflowRestError as e:
+            if e.error_code != "RESOURCE_DOES_NOT_EXIST":
+                raise
+        return self._call("POST", "experiments/create",
+                          body={"name": name})["experiment_id"]
+
+    def create_run(self, experiment_id: str,
+                   run_name: str | None = None) -> str:
+        tags = ([{"key": "mlflow.runName", "value": run_name}]
+                if run_name else [])
+        out = self._call("POST", "runs/create", body={
+            "experiment_id": experiment_id,
+            "start_time": int(time.time() * 1e3),
+            "tags": tags,
+        })
+        return out["run"]["info"]["run_id"]
+
+    def end_run(self, run_id: str, status: str = "FINISHED") -> None:
+        self._call("POST", "runs/update", body={
+            "run_id": run_id, "status": status,
+            "end_time": int(time.time() * 1e3),
+        })
+
+    def _get_run_raw(self, run_id: str) -> dict:
+        return self._call("GET", "runs/get",
+                          params={"run_id": run_id})["run"]
+
+    def get_run(self, run_id: str) -> dict:
+        # same key shape as FileStore.create_run meta (store.py:90-97)
+        info = self._get_run_raw(run_id)["info"]
+        return {
+            "run_id": run_id,
+            "run_name": info.get("run_name"),
+            "experiment_id": info["experiment_id"],
+            "status": info.get("status"),
+            "start_time": int(info.get("start_time") or 0) / 1e3,
+            "end_time": (int(info["end_time"]) / 1e3
+                         if info.get("end_time") else None),
+        }
+
+    # -- params / metrics ---------------------------------------------------
+
+    def log_params(self, run_id: str, params: dict) -> None:
+        self._call("POST", "runs/log-batch", body={
+            "run_id": run_id,
+            "params": [{"key": str(k), "value": str(v)}
+                       for k, v in params.items()],
+        })
+
+    def get_params(self, run_id: str) -> dict:
+        data = self._get_run_raw(run_id).get("data", {})
+        return {p["key"]: p["value"] for p in data.get("params", [])}
+
+    def log_metric(self, run_id: str, key: str, value: float,
+                   step: int | None = None) -> None:
+        self._call("POST", "runs/log-metric", body={
+            "run_id": run_id, "key": key, "value": float(value),
+            "timestamp": int(time.time() * 1e3),
+            "step": 0 if step is None else int(step),
+        })
+
+    def get_metric_history(self, run_id: str, key: str) -> list[dict]:
+        out = self._call("GET", "metrics/get-history",
+                         params={"run_id": run_id, "metric_key": key})
+        # "ts" in seconds, matching FileStore.log_metric (store.py:130)
+        return [
+            {"step": int(m.get("step", 0)), "value": m["value"],
+             "ts": int(m.get("timestamp", 0)) / 1e3}
+            for m in out.get("metrics", [])
+        ]
+
+    # -- artifacts ----------------------------------------------------------
+
+    def artifact_dir(self, run_id: str) -> Path:
+        """Local staging dir; finalized by ``publish_artifacts``."""
+        d = self._ensure_scratch() / run_id
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def _artifact_http_path(self, artifact_uri: str, *parts: str) -> str:
+        """Map an ``mlflow-artifacts:/...`` run artifact root (what the
+        tracking server hands out under --serve-artifacts) onto the REST
+        proxy path."""
+        if not artifact_uri.startswith("mlflow-artifacts:/"):
+            raise MlflowRestError(
+                400, "INVALID_PARAMETER_VALUE",
+                f"artifact uri {artifact_uri!r} is not served over the "
+                "mlflow-artifacts REST proxy; run the tracking server "
+                "with --serve-artifacts or install the mlflow client extra",
+            )
+        rel = artifact_uri[len("mlflow-artifacts:/"):].strip("/")
+        return posixpath.join(rel, *parts)
+
+    def publish_artifacts(self, run_id: str, local_dir: Path) -> None:
+        local_dir = Path(local_dir)
+        root = self._get_run_raw(run_id)["info"]["artifact_uri"]
+        for f in sorted(local_dir.rglob("*")):
+            if not f.is_file():
+                continue
+            rel = posixpath.join(local_dir.name,
+                                 f.relative_to(local_dir).as_posix())
+            path = self._artifact_http_path(root, rel)
+            resp = self._http.put(
+                f"{self.uri}{_ARTIFACTS}/{path}", data=f.read_bytes(),
+                timeout=self.timeout_s,
+            )
+            if resp.status_code >= 400:
+                raise MlflowRestError(resp.status_code, "INTERNAL_ERROR",
+                                      resp.text[:200])
+
+    def _download_tree(self, http_root: str, dest: Path) -> None:
+        listing = self._http.get(
+            f"{self.uri}{_ARTIFACTS}", params={"path": http_root},
+            timeout=self.timeout_s,
+        )
+        if listing.status_code >= 400:
+            raise MlflowRestError(listing.status_code, "INTERNAL_ERROR",
+                                  listing.text[:200])
+        for entry in listing.json().get("files", []):
+            # per the proxy contract, entry["path"] is relative to the
+            # queried directory
+            sub = posixpath.join(http_root, entry["path"])
+            if entry.get("is_dir"):
+                self._download_tree(sub, dest / entry["path"])
+                continue
+            resp = self._http.get(f"{self.uri}{_ARTIFACTS}/{sub}",
+                                  timeout=self.timeout_s)
+            if resp.status_code >= 400:
+                raise MlflowRestError(resp.status_code, "INTERNAL_ERROR",
+                                      resp.text[:200])
+            out = dest / entry["path"]
+            out.parent.mkdir(parents=True, exist_ok=True)
+            out.write_bytes(resp.content)
+
+    # -- registry -----------------------------------------------------------
+
+    def create_model_version(self, name: str, run_id: str | None,
+                             artifact_dir: Path) -> int:
+        source = posixpath.join(
+            self._get_run_raw(run_id)["info"]["artifact_uri"],
+            Path(artifact_dir).name,
+        )
+        try:
+            self._call("POST", "registered-models/create",
+                       body={"name": name})
+        except MlflowRestError as e:
+            if e.error_code != "RESOURCE_ALREADY_EXISTS":
+                raise
+        out = self._call("POST", "model-versions/create", body={
+            "name": name, "source": source, "run_id": run_id,
+        })
+        return int(out["model_version"]["version"])
+
+    def list_model_versions(self, name: str) -> list[dict]:
+        out = self._call("GET", "model-versions/search",
+                         params={"filter": f"name='{name}'"})
+        return sorted(
+            (
+                {
+                    "version": int(v["version"]),
+                    "run_id": v.get("run_id"),
+                    "stage": v.get("current_stage") or "None",
+                }
+                for v in out.get("model_versions", [])
+            ),
+            key=lambda v: v["version"],
+        )
+
+    def latest_version(self, name: str) -> dict:
+        versions = self.list_model_versions(name)
+        if not versions:
+            raise KeyError(f"registered model {name!r} has no versions")
+        return versions[-1]
+
+    def set_alias(self, name: str, alias: str, version: int) -> None:
+        self._call("POST", "registered-models/alias", body={
+            "name": name, "alias": alias, "version": str(version),
+        })
+
+    def get_alias(self, name: str, alias: str) -> int | None:
+        try:
+            out = self._call("GET", "registered-models/alias",
+                             params={"name": name, "alias": alias})
+        except MlflowRestError as e:
+            # only "no such alias/model" means None; connectivity/auth
+            # failures must surface, not masquerade as a missing alias
+            if e.error_code in ("RESOURCE_DOES_NOT_EXIST",
+                                "INVALID_PARAMETER_VALUE"):
+                return None
+            raise
+        return int(out["model_version"]["version"])
+
+    def version_path(self, name: str, version: int) -> Path:
+        """Download the registry version's model artifacts to a local dir."""
+        out = self._call("GET", "model-versions/get",
+                         params={"name": name, "version": str(version)})
+        source = out["model_version"]["source"]
+        dest = self._ensure_scratch() / "downloads" / name / str(version)
+        dest.mkdir(parents=True, exist_ok=True)
+        self._download_tree(self._artifact_http_path(source), dest)
+        return dest

@@ -259,11 +259,11 @@ def train_model(
                 # any other integer coding (class indices {0,2}, 0..K
                 # multi-class labels) would silently become ~K/255 targets,
                 # so reject it loudly instead of training against noise
-                values = np.unique(ys)
-                if not np.isin(values, (0, 255)).all():
+                # (one O(N) pass; the sort for the message only on error)
+                if not ((ys == 0) | (ys == 255)).all():
                     raise ValueError(
                         "integer masks must be coded {0,1} or {0,255}; got "
-                        f"values {values[:8].tolist()}"
+                        f"values {np.unique(ys)[:8].tolist()}"
                     )
                 ys = np.asarray(ys, np.float32) / 255.0
             else:

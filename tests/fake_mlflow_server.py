@@ -1,0 +1,312 @@
+"""In-process fake MLflow tracking server, socket-level.
+
+Implements the slice of MLflow's REST surface (``/api/2.0/mlflow/...`` plus
+the ``mlflow-artifacts`` proxy of ``mlflow server --serve-artifacts``) that
+tracking/rest_backend.py speaks, backed by in-memory state. The point is to
+exercise the REST client over a REAL HTTP socket -- request serialization,
+status/error-code handling, artifact upload/download byte round-trips --
+without the mlflow package or network access (round-4 verdict item 8).
+
+Response shapes follow the public MLflow REST API docs; error responses are
+``{"error_code": ..., "message": ...}`` with the matching HTTP status, which
+is the contract get_alias/get_or_create_experiment branch on.
+"""
+
+from __future__ import annotations
+
+import json
+import posixpath
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+_API = "/api/2.0/mlflow/"
+_ARTIFACTS = "/api/2.0/mlflow-artifacts/artifacts"
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.experiments: dict[str, str] = {}  # name -> id
+        self.runs: dict[str, dict] = {}
+        self.artifacts: dict[str, bytes] = {}  # posix path -> content
+        self.models: dict[str, dict] = {}  # name -> {versions, aliases}
+
+
+class FakeMlflowServer:
+    """``with FakeMlflowServer() as uri: ...`` serves on 127.0.0.1."""
+
+    def __init__(self):
+        self.state = _State()
+        state = self.state
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # keep test output clean
+                pass
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, error_code: str, msg: str) -> None:
+                self._json(code, {"error_code": error_code, "message": msg})
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                return json.loads(raw) if raw else {}
+
+            # -- artifacts proxy -------------------------------------------
+
+            def _artifact_rel(self) -> str:
+                return urlparse(self.path).path[len(_ARTIFACTS):].strip("/")
+
+            def do_PUT(self):
+                if not urlparse(self.path).path.startswith(_ARTIFACTS):
+                    return self._error(404, "ENDPOINT_NOT_FOUND", self.path)
+                rel = self._artifact_rel()
+                n = int(self.headers.get("Content-Length") or 0)
+                data = self.rfile.read(n)
+                with state.lock:
+                    state.artifacts[rel] = data
+                self._json(200, {})
+
+            def _artifact_get(self):
+                parsed = urlparse(self.path)
+                rel = self._artifact_rel()
+                if not rel:  # directory listing: GET .../artifacts?path=
+                    q = parse_qs(parsed.query)
+                    root = q.get("path", [""])[0].strip("/")
+                    with state.lock:
+                        names = {}
+                        for p in state.artifacts:
+                            if not p.startswith(root + "/"):
+                                continue
+                            head = p[len(root) + 1:].split("/", 1)
+                            if len(head) == 1:
+                                names[head[0]] = {
+                                    "path": head[0], "is_dir": False,
+                                    "file_size": len(state.artifacts[p]),
+                                }
+                            else:
+                                names.setdefault(
+                                    head[0], {"path": head[0], "is_dir": True}
+                                )
+                    return self._json(
+                        200, {"files": sorted(names.values(),
+                                              key=lambda f: f["path"])}
+                    )
+                with state.lock:
+                    data = state.artifacts.get(rel)
+                if data is None:
+                    return self._error(404, "RESOURCE_DOES_NOT_EXIST", rel)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            # -- tracking API ----------------------------------------------
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                if parsed.path.startswith(_ARTIFACTS):
+                    return self._artifact_get()
+                if not parsed.path.startswith(_API):
+                    return self._error(404, "ENDPOINT_NOT_FOUND", self.path)
+                ep = parsed.path[len(_API):]
+                q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                with state.lock:
+                    if ep == "experiments/get-by-name":
+                        name = q.get("experiment_name", "")
+                        if name not in state.experiments:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST", name)
+                        return self._json(200, {"experiment": {
+                            "experiment_id": state.experiments[name],
+                            "name": name,
+                        }})
+                    if ep == "runs/get":
+                        run = state.runs.get(q.get("run_id", ""))
+                        if run is None:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST",
+                                q.get("run_id", ""))
+                        return self._json(200, {"run": {
+                            "info": run["info"],
+                            "data": {
+                                "params": [
+                                    {"key": k, "value": v}
+                                    for k, v in run["params"].items()
+                                ],
+                            },
+                        }})
+                    if ep == "metrics/get-history":
+                        run = state.runs.get(q.get("run_id", ""))
+                        if run is None:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST",
+                                q.get("run_id", ""))
+                        return self._json(200, {
+                            "metrics": run["metrics"].get(
+                                q.get("metric_key", ""), []),
+                        })
+                    if ep == "model-versions/search":
+                        # filter grammar: name='<model>'
+                        filt = q.get("filter", "")
+                        name = filt.split("'")[1] if "'" in filt else ""
+                        model = state.models.get(name, {"versions": []})
+                        return self._json(
+                            200, {"model_versions": model["versions"]})
+                    if ep == "model-versions/get":
+                        model = state.models.get(q.get("name", ""))
+                        if model is not None:
+                            for v in model["versions"]:
+                                if v["version"] == q.get("version"):
+                                    return self._json(
+                                        200, {"model_version": v})
+                        return self._error(
+                            404, "RESOURCE_DOES_NOT_EXIST",
+                            f"{q.get('name')}/{q.get('version')}")
+                    if ep == "registered-models/alias":
+                        model = state.models.get(q.get("name", ""))
+                        ver = (model or {"aliases": {}})["aliases"].get(
+                            q.get("alias", ""))
+                        if model is None or ver is None:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST",
+                                f"{q.get('name')}@{q.get('alias')}")
+                        for v in model["versions"]:
+                            if v["version"] == ver:
+                                return self._json(200, {"model_version": v})
+                        return self._error(
+                            404, "RESOURCE_DOES_NOT_EXIST", ver)
+                return self._error(404, "ENDPOINT_NOT_FOUND", ep)
+
+            def do_POST(self):
+                parsed = urlparse(self.path)
+                if not parsed.path.startswith(_API):
+                    return self._error(404, "ENDPOINT_NOT_FOUND", self.path)
+                ep = parsed.path[len(_API):]
+                body = self._body()
+                with state.lock:
+                    if ep == "experiments/create":
+                        name = body["name"]
+                        if name in state.experiments:
+                            return self._error(
+                                400, "RESOURCE_ALREADY_EXISTS", name)
+                        exp_id = str(len(state.experiments) + 1)
+                        state.experiments[name] = exp_id
+                        return self._json(200, {"experiment_id": exp_id})
+                    if ep == "runs/create":
+                        run_id = uuid.uuid4().hex
+                        exp_id = body["experiment_id"]
+                        name = next(
+                            (t["value"] for t in body.get("tags", [])
+                             if t["key"] == "mlflow.runName"), None)
+                        state.runs[run_id] = {
+                            "info": {
+                                "run_id": run_id,
+                                "run_name": name,
+                                "experiment_id": exp_id,
+                                "status": "RUNNING",
+                                "start_time": body.get(
+                                    "start_time", int(time.time() * 1e3)),
+                                "artifact_uri": (
+                                    f"mlflow-artifacts:/{exp_id}/{run_id}"
+                                    "/artifacts"),
+                            },
+                            "params": {},
+                            "metrics": {},
+                        }
+                        return self._json(
+                            200, {"run": {"info": state.runs[run_id]["info"]}})
+                    if ep == "runs/update":
+                        run = state.runs.get(body.get("run_id", ""))
+                        if run is None:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST",
+                                body.get("run_id", ""))
+                        run["info"]["status"] = body.get("status", "FINISHED")
+                        if "end_time" in body:
+                            run["info"]["end_time"] = body["end_time"]
+                        return self._json(200, {"run_info": run["info"]})
+                    if ep == "runs/log-batch":
+                        run = state.runs.get(body.get("run_id", ""))
+                        if run is None:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST",
+                                body.get("run_id", ""))
+                        for p in body.get("params", []):
+                            run["params"][p["key"]] = p["value"]
+                        for m in body.get("metrics", []):
+                            run["metrics"].setdefault(m["key"], []).append(m)
+                        return self._json(200, {})
+                    if ep == "runs/log-metric":
+                        run = state.runs.get(body.get("run_id", ""))
+                        if run is None:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST",
+                                body.get("run_id", ""))
+                        run["metrics"].setdefault(body["key"], []).append({
+                            "key": body["key"], "value": body["value"],
+                            "timestamp": body.get("timestamp", 0),
+                            "step": body.get("step", 0),
+                        })
+                        return self._json(200, {})
+                    if ep == "registered-models/create":
+                        name = body["name"]
+                        if name in state.models:
+                            return self._error(
+                                400, "RESOURCE_ALREADY_EXISTS", name)
+                        state.models[name] = {"versions": [], "aliases": {}}
+                        return self._json(
+                            200, {"registered_model": {"name": name}})
+                    if ep == "model-versions/create":
+                        model = state.models.get(body["name"])
+                        if model is None:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST", body["name"])
+                        version = str(len(model["versions"]) + 1)
+                        entry = {
+                            "name": body["name"], "version": version,
+                            "source": body.get("source"),
+                            "run_id": body.get("run_id"),
+                            "current_stage": "None",
+                        }
+                        model["versions"].append(entry)
+                        return self._json(200, {"model_version": entry})
+                    if ep == "registered-models/alias":
+                        model = state.models.get(body.get("name", ""))
+                        if model is None:
+                            return self._error(
+                                404, "RESOURCE_DOES_NOT_EXIST",
+                                body.get("name", ""))
+                        model["aliases"][body["alias"]] = str(body["version"])
+                        return self._json(200, {})
+                return self._error(404, "ENDPOINT_NOT_FOUND", ep)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    @property
+    def uri(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> str:
+        self._thread.start()
+        return self.uri
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
