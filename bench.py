@@ -206,7 +206,7 @@ def main() -> None:
     )
     scale = jnp.float32(0.001)
 
-    def make_fused_step(forward, batch: int, gcfg):
+    def make_fused_step(forward, batch: int, gcfg, impl: str = "dense"):
         depth_b = jnp.broadcast_to(depth, (batch, h, w))
         intr_b = jnp.broadcast_to(intrinsics, (batch, 3, 3))
         scale_b = jnp.broadcast_to(scale, (batch,))
@@ -214,7 +214,26 @@ def main() -> None:
         def per_frame(mm, dd, kk, ss):
             return geometry.compute_curvature_profile(mm, dd, kk, ss, gcfg)
 
+        def one_frame(fi, dd, kk, ss):
+            x = pipeline.preprocess(fi[None], 256)
+            logits = (forward(x) if forward is not None
+                      else model.apply(variables, x, train=False))
+            m = pipeline.logits_to_native_masks(logits, h, w)[0]
+            prof = per_frame(m, dd, kk, ss)
+            dep = (m & jnp.uint8(1)) ^ (
+                prof.mean_curvature > 1e30
+            ).astype(jnp.uint8)
+            return fi ^ dep[..., None]
+
         def fused_step(f):  # f: [B, H, W, 3] uint8
+            if impl == "scan" and batch > 1:
+                # scan-over-frames inside ONE dispatch: B=1 VMEM residency,
+                # amortized launch (ServerConfig.batch_impl="scan")
+                _, out = lax.scan(
+                    lambda c, inp: (c, one_frame(*inp)), 0,
+                    (f, depth_b, intr_b, scale_b),
+                )
+                return out
             x = pipeline.preprocess(f, 256)
             logits = (forward(x) if forward is not None
                       else model.apply(variables, x, train=False))
@@ -237,8 +256,8 @@ def main() -> None:
 
         return fused_step
 
-    def bench(forward, batch: int, rt_ms: float, gcfg=None):
-        step = make_fused_step(forward, batch, gcfg or geom_cfg)
+    def bench(forward, batch: int, rt_ms: float, gcfg=None, impl="dense"):
+        step = make_fused_step(forward, batch, gcfg or geom_cfg, impl)
 
         @jax.jit
         def chained(f0):
@@ -275,6 +294,12 @@ def main() -> None:
     # batching targets dispatch amortization, not per-frame speedup.
     for b in (4, 8):
         results[f"batched_b{b}"], _ = bench(best_fwd, b, rt_ms)
+    # scan-over-frames batching (ServerConfig.batch_impl="scan"): one
+    # dispatch, B=1 VMEM residency -- the round-4 verdict's candidate fix
+    # for dense batching's VMEM-spill anti-scaling
+    for b in (4, 8):
+        results[f"batched_scan_b{b}"], _ = bench(
+            best_fwd, b, rt_ms, impl="scan")
 
     # MFU: conv-only analytic FLOPs over the v5e bf16 peak (the standard
     # matmul-FLOP MFU basis; utils/flops.py, validated vs XLA cost

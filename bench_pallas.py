@@ -203,12 +203,112 @@ def bench_full_forward(rt_ms: float) -> dict:
             "speedup": round(t_flax / t_pallas, 3)}
 
 
+def autotune(rt_ms: float, focus=None) -> dict:
+    """Sweep every budget-feasible (tile_h, tile_co, dx_major) per conv
+    shape (ops/pallas/tuning.candidates) with the chained-scan timing; a
+    config is recorded as an override only when it beats BOTH the analytic
+    heuristic and a re-measured XLA anchor by >3% (otherwise the entry is
+    dropped so the uniform-dispatch decision stays evidence-based). Writes
+    PALLAS_TUNE.json, which unet_infer's dispatch consults per launch."""
+    from robotic_discovery_platform_tpu.ops.pallas import (
+        conv3x3_bn_relu, conv3x3_bn_relu_xla, tuning)
+
+    rng = np.random.default_rng(0)
+    entries, report = {}, []
+    shapes = focus or CONV3X3_SHAPES
+    for h, w, ci, co in shapes:
+        x = jnp.asarray(rng.normal(size=(1, h, w, ci)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(3, 3, ci, co)) * 0.1, jnp.float32)
+        scale = jnp.ones((co,), jnp.float32)
+        bias = jnp.zeros((co,), jnp.float32)
+        reps_in = -(-ci // co)
+
+        def step_for(tiling, kernel=k, s=scale, b=bias, cin=ci, r=reps_in):
+            def step(c):
+                y = conv3x3_bn_relu(c, kernel, s, b, relu=True,
+                                    tiling=tiling)
+                return jnp.tile(y, (1, 1, 1, r))[..., :cin].astype(
+                    jnp.bfloat16)
+            return step
+
+        def step_xla(c, kernel=k, s=scale, b=bias, cin=ci, r=reps_in):
+            y = conv3x3_bn_relu_xla(c, kernel, s, b, relu=True)
+            return jnp.tile(y, (1, 1, 1, r))[..., :cin].astype(jnp.bfloat16)
+
+        cands = tuning.candidates(h, w, ci, co)
+        t_heur = _time_chain(step_for(None), x, rt_ms)
+        t_xla = _time_chain(step_xla, x, rt_ms)
+        best_t, best_cfg = t_heur, cands[0]
+        for cand in cands[1:]:
+            try:
+                t = _time_chain(step_for(cand), x, rt_ms)
+            except Exception as exc:  # infeasible config (compile/VMEM)
+                print(f"#   {h}x{w} {ci}->{co} {cand}: {type(exc).__name__}",
+                      file=sys.stderr)
+                continue
+            if t < best_t:
+                best_t, best_cfg = t, cand
+        improved = best_t < t_heur * 0.97 and best_t < t_xla * 0.97
+        row = {
+            "h": h, "w": w, "cin": ci, "cout": co,
+            "heuristic": {"cfg": list(cands[0]),
+                          "ms": round(t_heur, 4)},
+            "best": {"cfg": list(best_cfg), "ms": round(best_t, 4)},
+            "xla_ms": round(t_xla, 4),
+            "tuned": bool(improved),
+            "n_candidates": len(cands),
+        }
+        report.append(row)
+        print(f"# tune {h}x{w} {ci}->{co}: heur={t_heur:.3f}ms "
+              f"best={best_t:.3f}ms ({best_cfg}) xla={t_xla:.3f}ms "
+              f"{'TUNED' if improved else 'keep-heuristic'}",
+              file=sys.stderr)
+        if improved:
+            th, tc, dxm = best_cfg
+            entries[tuning.key(h, w, ci, co)] = {
+                "tile_h": th, "tile_co": tc, "dx_major": dxm,
+                "ms": round(best_t, 4),
+                "heuristic_ms": round(t_heur, 4),
+                "xla_ms": round(t_xla, 4),
+            }
+    meta = {
+        "device": jax.devices()[0].device_kind,
+        "chain": CHAIN,
+        "roundtrip_ms": round(rt_ms, 1),
+        "criterion": ">3% faster than heuristic AND xla",
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if focus:
+        # focused re-tune: merge over the existing table -- replace every
+        # swept shape's entry (tuned or dropped), keep the rest
+        prev = dict(tuning._table())
+        for h, w, ci, co in shapes:
+            prev.pop(tuning.key(h, w, ci, co), None)
+        prev.update(entries)
+        entries = prev
+    path = tuning.save_entries(entries, meta)
+    print(f"# wrote {path} with {len(entries)} overrides", file=sys.stderr)
+    return {"entries": len(entries), "report": report}
+
+
 def main() -> None:
     if jax.default_backend() != "tpu":
         print("PALLASBENCH needs the TPU backend (kernels interpret-only "
               "on CPU)", file=sys.stderr)
         sys.exit(1)
     rt_ms = _roundtrip_ms()
+    if len(sys.argv) > 1 and sys.argv[1] == "autotune":
+        # optional shape filter: "autotune 32" tunes only 32x32 layers
+        focus = None
+        if len(sys.argv) > 2:
+            want = int(sys.argv[2])
+            focus = [s for s in CONV3X3_SHAPES if s[0] == want]
+            if not focus:
+                sys.exit(f"no conv shape with H={want} "
+                         f"(have {sorted({s[0] for s in CONV3X3_SHAPES})})")
+        out = autotune(rt_ms, focus)
+        print(json.dumps({"autotuned_overrides": out["entries"]}))
+        return
     result = {
         "backend": jax.default_backend(),
         "device": jax.devices()[0].device_kind,

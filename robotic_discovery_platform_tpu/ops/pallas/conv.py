@@ -69,27 +69,39 @@ def _lane(n: int) -> int:
     return -(-n // 128) * 128
 
 
+_VMEM_BUDGET = 10 * 1024 * 1024  # against the 16 MB scoped-vmem limit
+
+
+def vmem_bytes_3x3(tile_h: int, tile_co: int, w: int, cin: int,
+                   in_itemsize: int, out_itemsize: int) -> int:
+    """Estimated VMEM for one 3x3-conv grid step: halo slab, weight block,
+    f32 accumulator, output block -- lane padding on every final dim and
+    the Pallas pipeline's double buffering (x2 on every streamed block)
+    counted. Shared by the analytic heuristic and the autotuner's
+    candidate filter (ops/pallas/tuning.py)."""
+    w_bytes = 2 * 9 * cin * _lane(tile_co) * in_itemsize
+    slab = 2 * (tile_h + 2) * (w + 2) * _lane(cin) * in_itemsize
+    acc = tile_h * w * _lane(tile_co) * 4
+    out = 2 * tile_h * w * _lane(tile_co) * out_itemsize
+    return w_bytes + slab + acc + out
+
+
 def _tiles_3x3(h: int, w: int, cin: int, cout: int,
                in_itemsize: int, out_itemsize: int):
-    """(tile_h, tile_co) under the VMEM budget, counting the halo slab,
-    weight block, f32 accumulator, output block, lane padding on every
-    final dim, and the Pallas pipeline's double buffering (x2 on every
-    streamed block). 10 MB against the 16 MB scoped-vmem limit: with the
-    lane padding now counted for real, this reproduces the serving tiles
-    that have been stable since round 2 while keeping narrow-channel
-    (test-sized) models under the hard limit."""
-    budget = 10 * 1024 * 1024
+    """(tile_h, tile_co) under the VMEM budget (vmem_bytes_3x3). 10 MB
+    against the 16 MB scoped-vmem limit: with the lane padding counted for
+    real, this reproduces the serving tiles that have been stable since
+    round 2 while keeping narrow-channel (test-sized) models under the
+    hard limit."""
+    budget = _VMEM_BUDGET
     tile_co = _pick_tile(cout, 256)
     while (tile_co > 128
            and 2 * 9 * cin * _lane(tile_co) * in_itemsize > budget // 3):
         tile_co = _pick_tile(cout, tile_co // 2)
-    w_bytes = 2 * 9 * cin * _lane(tile_co) * in_itemsize
     tile_h = _pick_tile(h, 64)
     while tile_h > 1:
-        slab = 2 * (tile_h + 2) * (w + 2) * _lane(cin) * in_itemsize
-        acc = tile_h * w * _lane(tile_co) * 4
-        out = 2 * tile_h * w * _lane(tile_co) * out_itemsize
-        if w_bytes + slab + acc + out <= budget:
+        if vmem_bytes_3x3(tile_h, tile_co, w, cin, in_itemsize,
+                          out_itemsize) <= budget:
             break
         tile_h = _pick_tile(h, tile_h // 2)
     return tile_h, tile_co
@@ -143,11 +155,11 @@ def _conv3x3_kernel(x_ref, w_ref, sb_ref, o_ref, *, tile_h, width, relu,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("relu", "out_dtype", "interpret")
+    jax.jit, static_argnames=("relu", "out_dtype", "interpret", "tiling")
 )
 def conv3x3_bn_relu(
     x, w, scale, bias, *, relu: bool = True, out_dtype=None,
-    interpret: bool = False,
+    interpret: bool = False, tiling=None,
 ):
     """Fused NHWC 3x3 SAME conv + per-channel scale/bias (+ ReLU).
 
@@ -162,13 +174,26 @@ def conv3x3_bn_relu(
         relu: apply max(y, 0) in the epilogue.
         out_dtype: output dtype (default: x.dtype).
         interpret: run the Pallas interpreter (CPU tests).
+        tiling: optional (tile_h, tile_co, dx_major) override of the
+            analytic VMEM-budget heuristic -- the autotuner
+            (bench_pallas.py autotune / ops/pallas/tuning.py) sweeps these
+            per shape; tile_h must divide H and tile_co divide Cout.
     """
     b, h, width, cin = x.shape
     cout = w.shape[-1]
     out_dtype = x.dtype if out_dtype is None else out_dtype
-    tile_h, tile_co = _tiles_3x3(
-        h, width, cin, cout, x.dtype.itemsize, jnp.dtype(out_dtype).itemsize
-    )
+    if tiling is not None:
+        tile_h, tile_co, dx_major = tiling
+        if h % tile_h or cout % tile_co:
+            raise ValueError(
+                f"tiling {tiling} does not divide (H={h}, Cout={cout})"
+            )
+    else:
+        tile_h, tile_co = _tiles_3x3(
+            h, width, cin, cout, x.dtype.itemsize,
+            jnp.dtype(out_dtype).itemsize
+        )
+        dx_major = width <= 192
 
     # Flatten batch into rows: each image is padded separately, so a halo
     # slab never crosses an image boundary (row tiles divide H exactly).
@@ -180,7 +205,7 @@ def conv3x3_bn_relu(
 
     kern = functools.partial(
         _conv3x3_kernel, tile_h=tile_h, width=width, relu=relu,
-        dx_major=width <= 192,
+        dx_major=dx_major,
     )
     tiles = h // tile_h
     out = pl.pallas_call(

@@ -52,8 +52,13 @@ def _dispatch_3x3(x, w, scale, bias, *, relu, interpret, force):
         force is None and not (interpret or pconv.use_pallas())
     ):
         return pconv.conv3x3_bn_relu_xla(x, w, scale, bias, relu=relu)
+    from robotic_discovery_platform_tpu.ops.pallas import tuning
+
+    b, h, width, cin = x.shape
+    tiling = tuning.lookup(h, width, cin, w.shape[-1], batch=b,
+                           dtype=jnp.dtype(x.dtype).name)
     return pconv.conv3x3_bn_relu(
-        x, w, scale, bias, relu=relu, interpret=interpret
+        x, w, scale, bias, relu=relu, interpret=interpret, tiling=tiling
     )
 
 

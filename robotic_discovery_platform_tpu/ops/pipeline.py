@@ -174,3 +174,44 @@ def make_batch_analyzer(
         )
 
     return analyze
+
+
+def make_scan_batch_analyzer(
+    model,
+    img_size: int = 256,
+    geom_cfg: GeometryConfig = GeometryConfig(),
+    threshold: float = 0.5,
+    forward=None,
+):
+    """Batched analyzer that keeps SINGLE-FRAME working-set residency:
+    one compiled dispatch scans the B frames sequentially with
+    ``lax.scan``, so peak activation memory is the B=1 footprint while the
+    per-dispatch host/compile/launch overhead is amortized over the batch.
+
+    Rationale (round-4 verdict item 5): dense batching (make_batch_analyzer)
+    anti-scales on this chip -- the U-Net's wide 256-by-256 feature maps
+    spill VMEM at B>=4 (measured 349.5 aggregate FPS at B=4 vs 501.5 at
+    B=1) -- because batching multiplies the live activation set by B.
+    Scanning trades the MXU's batched-matmul efficiency for staying inside
+    VMEM; which wins is an empirical question bench.py measures
+    (batched_scan_b*). Same call shape as make_batch_analyzer, so
+    BatchDispatcher can use either via ServerConfig.batch_impl.
+    """
+
+    @jax.jit
+    def analyze(variables, frames_rgb, depths, intrinsics, depth_scales):
+        intr = jnp.asarray(intrinsics, jnp.float32)
+        scales = jnp.asarray(depth_scales, jnp.float32)
+
+        def step(carry, inp):
+            f, d, k, s = inp
+            out = _analyze_batch(
+                model, variables, f[None], d[None], k[None], s[None],
+                img_size, geom_cfg, threshold, forward,
+            )
+            return carry, jax.tree.map(lambda a: a[0], out)
+
+        _, outs = jax.lax.scan(step, 0, (frames_rgb, depths, intr, scales))
+        return outs  # every leaf stacked to leading B by scan
+
+    return analyze

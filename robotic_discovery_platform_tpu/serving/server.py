@@ -178,7 +178,13 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 BatchDispatcher,
             )
 
-            batch_analyze = pipeline.make_batch_analyzer(
+            if cfg.batch_impl == "dense":
+                make_batched = pipeline.make_batch_analyzer
+            elif cfg.batch_impl == "scan":
+                make_batched = pipeline.make_scan_batch_analyzer
+            else:
+                raise ValueError(f"unknown batch_impl {cfg.batch_impl!r}")
+            batch_analyze = make_batched(
                 model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
                 forward=forward,
             )

@@ -174,6 +174,14 @@ class ServerConfig:
     # dispatcher for workloads where the tradeoff differs.
     batch_window_ms: float = 0.0
     max_batch: int = 8  # per-dispatch cap when micro-batching
+    # Batched-dispatch implementation when micro-batching is on:
+    # "dense" = one [B, ...] forward (make_batch_analyzer) -- best when the
+    # batch fits VMEM; "scan" = one dispatch that lax.scans the frames
+    # sequentially (make_scan_batch_analyzer) -- keeps the B=1 working-set
+    # residency that dense batching loses on wide 256x256 feature maps
+    # (measured anti-scaling: B=4 349.5 vs B=1 501.5 aggregate FPS), while
+    # still amortizing per-dispatch overhead. bench.py measures both.
+    batch_impl: str = "dense"
     # Geometry decimation stride (GeometryConfig.stride). 1 = reference-
     # exact dense semantics, the DEFAULT: serving numerics match the
     # reference out of the box. 2 is the opt-in fast profile -- it quarters

@@ -26,6 +26,12 @@ from robotic_discovery_platform_tpu.utils.config import ModelConfig
 from fake_mlflow_server import FakeMlflowServer
 
 
+def _mlflow_installed() -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec("mlflow") is not None
+
+
 @pytest.fixture()
 def rest_uri():
     from robotic_discovery_platform_tpu.tracking import api
@@ -33,18 +39,30 @@ def rest_uri():
     prev_uri = tracking.get_tracking_uri()
     prev_exp = api._state.experiment_id
     with FakeMlflowServer() as uri:
-        tracking.set_tracking_uri(uri)
+        # forced REST scheme: these tests target RestMlflowStore even in
+        # an env where the mlflow extra is installed (there, a bare http
+        # URI would select the mlflow-client adapter instead)
+        tracking.set_tracking_uri(f"mlflow-rest+{uri}")
         yield uri
         tracking.set_tracking_uri(prev_uri)
         api._state.experiment_id = prev_exp
 
 
+@pytest.mark.skipif(
+    _mlflow_installed(),
+    reason="with the mlflow extra installed, http URIs route to the "
+           "mlflow-client adapter by design",
+)
 def test_http_uri_routes_to_rest_store_without_mlflow(rest_uri):
     from robotic_discovery_platform_tpu.tracking import api
 
-    # the mlflow package is absent in this image, so an http:// tracking
-    # URI must transparently select the REST client
-    assert isinstance(api._store(), RestMlflowStore)
+    # without the mlflow package, a bare http:// tracking URI must
+    # transparently select the REST client
+    tracking.set_tracking_uri(rest_uri)
+    try:
+        assert isinstance(api._store(), RestMlflowStore)
+    finally:
+        tracking.set_tracking_uri(f"mlflow-rest+{rest_uri}")
 
 
 def test_rest_round_trip(rest_uri):

@@ -242,3 +242,55 @@ def test_analytic_flops_match_xla_cost_analysis(bilinear):
     xla = cost["flops"] if isinstance(cost, dict) else cost[0]["flops"]
     mine = flops_lib.unet_forward_flops(64, base=16, bilinear=bilinear)
     assert 0.85 <= mine / xla <= 1.15, (mine, xla)
+
+
+def test_conv3x3_explicit_tiling_matches_xla():
+    """The autotuner's explicit (tile_h, tile_co, dx_major) overrides must
+    be numerically identical to the heuristic path for every feasible
+    candidate shape class (correctness is tiling-invariant by
+    construction; this pins it)."""
+    from robotic_discovery_platform_tpu.ops.pallas import tuning
+
+    x = _rand(1, 16, 16, 8)
+    k = _rand(3, 3, 8, 16, scale=0.1)
+    s, bias = _rand(16), _rand(16)
+    want = conv3x3_bn_relu_xla(x, k, s, bias, relu=True)
+    for cand in tuning.candidates(16, 16, 8, 16, 4, 4)[:6]:
+        got = conv3x3_bn_relu(x, k, s, bias, relu=True, interpret=True,
+                              tiling=cand)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4,
+            err_msg=str(cand),
+        )
+    with pytest.raises(ValueError, match="does not divide"):
+        conv3x3_bn_relu(x, k, s, bias, interpret=True, tiling=(5, 16, True))
+
+
+def test_tuning_candidates_and_lookup(tmp_path, monkeypatch):
+    """candidates() yields budget-feasible divisor configs with the
+    analytic heuristic first; lookup() honors a written table and ignores
+    entries that no longer divide the shape."""
+    from robotic_discovery_platform_tpu.ops.pallas import conv as pconv
+    from robotic_discovery_platform_tpu.ops.pallas import tuning
+
+    cands = tuning.candidates(32, 32, 512, 512)
+    th0, tc0 = pconv._tiles_3x3(32, 32, 512, 512, 2, 2)
+    assert cands[0] == (th0, tc0, True)  # heuristic first (w=32 <= 192)
+    assert len(cands) == len(set(cands)) > 1
+    for th, tc, _ in cands:
+        assert 32 % th == 0 and 512 % tc == 0
+        assert tuning.vmem_bytes_3x3(th, tc, 32, 512, 2, 2) <= (
+            pconv._VMEM_BUDGET)
+
+    monkeypatch.setattr(tuning, "_TUNE_PATH", tmp_path / "tune.json")
+    tuning.invalidate_cache()
+    assert tuning.lookup(32, 32, 512, 512) is None
+    tuning.save_entries({
+        tuning.key(32, 32, 512, 512): {
+            "tile_h": 8, "tile_co": 128, "dx_major": False},
+        tuning.key(64, 64, 128, 256): {
+            "tile_h": 5, "tile_co": 128, "dx_major": True},  # 5 ∤ 64
+    }, meta={})
+    assert tuning.lookup(32, 32, 512, 512) == (8, 128, False)
+    assert tuning.lookup(64, 64, 128, 256) is None  # non-dividing: ignored
+    tuning.invalidate_cache()

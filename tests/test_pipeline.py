@@ -86,3 +86,21 @@ def test_batch_analyzer_matches_single():
     np.testing.assert_allclose(
         float(b.mask_coverage[0]), float(s.mask_coverage), rtol=1e-5
     )
+
+    # the scan-over-frames batched variant (single-frame VMEM residency,
+    # ServerConfig.batch_impl="scan") must agree leaf-for-leaf with both
+    scan_batched = pipeline.make_scan_batch_analyzer(model, img_size=64)
+    sb = scan_batched(variables, frames, depths, ks, scales)
+    assert sb.mask.shape == (3, 120, 160)
+    np.testing.assert_array_equal(np.asarray(sb.mask[1]), np.asarray(s.mask))
+    np.testing.assert_allclose(
+        np.asarray(sb.mask_coverage), np.asarray(b.mask_coverage), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(sb.profile.mean_curvature),
+        np.asarray(b.profile.mean_curvature), rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sb.profile.spline_points),
+        np.asarray(b.profile.spline_points), rtol=1e-4, atol=1e-6,
+    )

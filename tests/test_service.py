@@ -482,6 +482,70 @@ def test_hot_reload_with_batching_swaps_dispatcher(tmp_path):
         servicer.close()
 
 
+def test_scan_batch_impl_serves(tmp_path):
+    """ServerConfig.batch_impl="scan" routes the dispatcher through the
+    scan-over-frames analyzer (single-frame VMEM residency) and serves the
+    same results as the per-frame path."""
+    import jax
+
+    from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
+
+    uri = f"file:{tmp_path}/mlruns"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    with tracking.start_run():
+        tracking.log_model(
+            init_unet(build_unet(mcfg), jax.random.key(0), 64), mcfg,
+            registered_model_name="Actuator-Segmenter",
+        )
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        model_img_size=64,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        calibration_path=str(tmp_path / "missing.npz"),
+        batch_window_ms=5.0,
+        max_batch=4,
+        batch_impl="scan",
+        reload_poll_s=0.0,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    try:
+        assert servicer.dispatcher is not None
+        rgb = np.zeros((64, 64, 3), np.uint8)
+        rgb[20:44] = 200  # a band the tiny model thresholds deterministically
+        depth = np.full((64, 64), 900, np.uint16)
+        k = server_lib._default_intrinsics(64, 64).astype(np.float32)
+        out = servicer.dispatcher.submit(rgb, depth, k, 0.001)
+        # equality anchor: the unbatched analyzer on the same frame
+        single = servicer.analyze(
+            servicer.variables, rgb, depth, k, np.float32(0.001)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out.mask), np.asarray(single.mask)
+        )
+        np.testing.assert_allclose(
+            float(out.mask_coverage), float(single.mask_coverage), rtol=1e-5
+        )
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+
+    with pytest.raises(ValueError, match="unknown batch_impl"):
+        server_lib.build_server(
+            ServerConfig(
+                address="localhost:0",
+                tracking_uri=uri,
+                model_img_size=64,
+                metrics_csv=str(tmp_path / "metrics.csv"),
+                calibration_path=str(tmp_path / "missing.npz"),
+                batch_window_ms=5.0,
+                batch_impl="nope",
+            )
+        )
+
+
 def test_reload_grace_timer_does_not_block_close(tmp_path):
     """close() shortly after a reload must cancel the pending grace-delayed
     teardown and stop the old dispatcher immediately -- not block interpreter
