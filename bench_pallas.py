@@ -92,6 +92,7 @@ def _time_chain(fn, x0, rt_ms: float, reps: int = 3) -> float:
 def bench_conv3x3(rt_ms: float) -> list[dict]:
     from robotic_discovery_platform_tpu.ops.pallas import (
         conv3x3_bn_relu, conv3x3_bn_relu_xla)
+    from robotic_discovery_platform_tpu.utils import flops as flops_lib
 
     rng = np.random.default_rng(0)
     rows = []
@@ -114,13 +115,26 @@ def bench_conv3x3(rt_ms: float) -> list[dict]:
 
         t_pallas = _time_chain(step, x, rt_ms)
         t_xla = _time_chain(step_xla, x, rt_ms)
+        # roofline: how close the better implementation runs to the chip's
+        # compute/bandwidth bound for this shape (utils/flops.py; the
+        # chain's feedback tile/slice overhead rides on the measured time,
+        # so pct_of_bound is understated -- a conservative bound)
+        roof = flops_lib.conv3x3_roofline_ms(h, w, ci, co)
+        best_ms = min(t_pallas, t_xla)
         rows.append({
             "op": "conv3x3_bn_relu", "h": h, "w": w, "cin": ci, "cout": co,
             "pallas_ms": round(t_pallas, 4), "xla_ms": round(t_xla, 4),
             "speedup": round(t_xla / t_pallas, 3),
+            "roofline_ms": round(roof["bound_ms"], 4),
+            "bound_by": roof["bound_by"],
+            "pallas_pct_of_bound": round(
+                100 * roof["bound_ms"] / t_pallas, 1),
+            "best_pct_of_bound": round(100 * roof["bound_ms"] / best_ms, 1),
         })
         print(f"# 3x3 {h}x{w} {ci}->{co}: pallas={t_pallas:.3f}ms "
-              f"xla={t_xla:.3f}ms x{t_xla / t_pallas:.2f}", file=sys.stderr)
+              f"xla={t_xla:.3f}ms x{t_xla / t_pallas:.2f} "
+              f"roof={roof['bound_ms']:.3f}ms ({roof['bound_by']})",
+              file=sys.stderr)
     return rows
 
 
@@ -292,6 +306,15 @@ def autotune(rt_ms: float, focus=None) -> dict:
 
 
 def main() -> None:
+    # honor an inherited JAX_PLATFORMS pin BEFORE the backend query below:
+    # without it, the query on this image enters TPU-tunnel discovery even
+    # when the caller asked for CPU, and a wedged tunnel hangs the guard
+    # instead of letting it exit (utils/platforms.py)
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        apply_env_platform,
+    )
+
+    apply_env_platform()
     if jax.default_backend() != "tpu":
         print("PALLASBENCH needs the TPU backend (kernels interpret-only "
               "on CPU)", file=sys.stderr)

@@ -18,6 +18,33 @@ this constant explicitly so they can be re-based for other chips.
 from __future__ import annotations
 
 V5E_PEAK_BF16_TFLOPS = 197.0
+# HBM bandwidth of one v5e chip (public spec: 819 GB/s). Used for
+# per-kernel roofline bounds: a launch cannot run faster than
+# max(flops / peak, bytes_moved / bandwidth).
+V5E_HBM_GBPS = 819.0
+
+
+def conv3x3_roofline_ms(h: int, w: int, cin: int, cout: int,
+                        batch: int = 1, itemsize: int = 2) -> dict:
+    """Roofline lower bound for one fused 3x3 conv+BN+ReLU launch:
+    compute time at the dense-bf16 MXU peak vs memory time for the
+    minimal HBM traffic (read input once, read weights once, write output
+    once -- halos/re-reads make real traffic strictly larger, so the
+    bound is optimistic and 'percent of bound' is conservative)."""
+    flops = 2 * 9 * batch * h * w * cin * cout
+    bytes_moved = itemsize * (
+        batch * h * w * cin + 9 * cin * cout + batch * h * w * cout
+    )
+    compute_ms = flops / (V5E_PEAK_BF16_TFLOPS * 1e12) * 1e3
+    memory_ms = bytes_moved / (V5E_HBM_GBPS * 1e9) * 1e3
+    return {
+        "flops": flops,
+        "bytes": bytes_moved,
+        "compute_ms": compute_ms,
+        "memory_ms": memory_ms,
+        "bound_ms": max(compute_ms, memory_ms),
+        "bound_by": "compute" if compute_ms >= memory_ms else "memory",
+    }
 
 
 def unet_forward_flops(img_size: int = 256, base: int = 64,
