@@ -232,13 +232,10 @@ def _child(spec_path: str) -> None:
         _arm_fault(spec["fault"], cfg.checkpoint_dir)
     res = train_model(cfg, model_cfg, resume=True,
                       register=spec["register"])
-    Path(spec["result_path"]).write_text(json.dumps({
-        "run_id": res.run_id,
-        "registry_version": res.registry_version,
-        "best_val_loss": res.best_val_loss,
-        "final_metrics": res.final_metrics,
-        "epochs_run": res.epochs_run,
-    }))
+    payload = res.to_jsonable()
+    # SupervisedResult carries exactly the reference result surface
+    payload.pop("wall_clock_s")
+    Path(spec["result_path"]).write_text(json.dumps(payload))
 
 
 if __name__ == "__main__":

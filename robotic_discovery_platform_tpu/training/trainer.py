@@ -206,6 +206,20 @@ class TrainResult:
     # benchmarks can separate steady-state rate from host contention
     epoch_seconds: list = None
 
+    def to_jsonable(self) -> dict:
+        """Plain-JSON form (metrics may be numpy/jax scalars) -- the ONE
+        serialization the CLI and the supervisor child both use, so the
+        two cannot drift."""
+        return {
+            "run_id": self.run_id,
+            "registry_version": self.registry_version,
+            "best_val_loss": float(self.best_val_loss),
+            "final_metrics": {k: float(v)
+                              for k, v in self.final_metrics.items()},
+            "epochs_run": int(self.epochs_run),
+            "wall_clock_s": round(float(self.wall_clock_s), 2),
+        }
+
 
 def train_model(
     cfg: TrainConfig = TrainConfig(),

@@ -214,3 +214,42 @@ def test_train_model_streams_from_disk(tmp_path):
     res = trainer.train_model(cfg, TINY_MODEL, register=False)
     assert np.isfinite(res.best_val_loss)
     assert "miou" in res.final_metrics
+
+
+@pytest.mark.slow
+def test_training_cli_module_main(tmp_path):
+    """`python -m robotic_discovery_platform_tpu.training` is the reference's
+    train_segmenter.py entry point as a CLI: section.field overrides, JSON
+    result line on stdout, clean error for a missing dataset."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    synthetic.generate_dataset(tmp_path / "ds", n=8, h=64, w=64)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable, "-m", "robotic_discovery_platform_tpu.training",
+        "--train.epochs", "1", "--train.batch_size", "4",
+        "--train.img_size", "32", "--train.validation_split", "0.25",
+        "--train.dataset_dir", str(tmp_path / "ds"),
+        "--train.tracking_uri", f"file:{tmp_path}/mlruns",
+        "--train.checkpoint_dir", str(tmp_path / "ckpt"),
+        "--model.base_features", "8", "--model.compute_dtype", "float32",
+        "--no-register",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=600)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["epochs_run"] == 1
+    assert out["registry_version"] is None
+    assert np.isfinite(out["best_val_loss"])
+
+    bad_cmd = list(cmd)
+    bad_cmd[bad_cmd.index(str(tmp_path / "ds"))] = str(tmp_path / "missing")
+    bad = subprocess.run(bad_cmd, capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert bad.returncode == 2
+    assert "images/ and masks/" in bad.stderr
+    assert "Traceback" not in bad.stderr  # one-line CLI error, not a dump
