@@ -265,6 +265,51 @@ def run_torch(seed: int) -> dict:
     }
 
 
+def parse_torch_log(log_path: Path) -> dict:
+    """Honest partial record of an in-flight torch anchor leg from its
+    progress log (one `torch[S] epoch E/50 val=V (Ts)` line per epoch).
+    Used when the wall-clock budget ends before the leg does: the partial
+    entry carries what IS measured (epochs completed, val-loss curve,
+    epoch pacing) and nothing else -- no eval scores are fabricated."""
+    import re
+
+    pat = re.compile(
+        r"torch\[(\d+)\] epoch (\d+)/(\d+) val=([\d.]+) \((\d+)s\)")
+    rows = [pat.search(line) for line in log_path.read_text().splitlines()]
+    rows = [m for m in rows if m]
+    if not rows:
+        raise ValueError(f"no torch progress lines in {log_path}")
+    seeds = {int(m.group(1)) for m in rows}
+    if len(seeds) != 1:
+        raise ValueError(
+            f"{log_path} mixes torch legs for seeds {sorted(seeds)}; "
+            "point torch_partial at a single-run log"
+        )
+    seed = seeds.pop()
+    total = int(rows[0].group(3))
+    epochs = [int(m.group(2)) for m in rows]
+    vals = [float(m.group(4)) for m in rows]
+    walls = [int(m.group(5)) for m in rows]
+    if walls != sorted(walls) or epochs != sorted(epochs):
+        raise ValueError(
+            f"{log_path} is not one monotonic run (appended/restarted "
+            "logs cannot be summarized honestly)"
+        )
+    deltas = [b - a for a, b in zip(walls, walls[1:])]
+    return {
+        "backend": "torch-cpu",
+        "seed": seed,
+        "partial": True,
+        "epochs_completed": max(epochs),
+        "epochs_planned": total,
+        "best_val_loss_so_far": round(min(vals), 5),
+        "val_loss_tail": [round(v, 5) for v in vals[-5:]],
+        **(_steady_state(deltas) if deltas else {}),
+        "note": "leg still running when the round's wall clock ended; "
+                "val-selection curve recorded, eval_miou not available",
+    }
+
+
 def _agg(runs: list[dict], key: str) -> dict:
     vals = [r[key] for r in runs if r.get(key) is not None]
     if not vals:
@@ -277,8 +322,12 @@ def _agg(runs: list[dict], key: str) -> dict:
 def summarize(result: dict) -> dict:
     legs = {}
     for leg in ("torch", "tpu_f32", "tpu_bf16"):
+        # *_partial entries are informational (in-flight legs recorded at
+        # wall-clock end); they carry no eval scores and must not be
+        # aggregated alongside completed runs
         runs = [v for k, v in result.items()
-                if k.startswith(f"{leg}_seed") and isinstance(v, dict)]
+                if k.startswith(f"{leg}_seed") and isinstance(v, dict)
+                and not k.endswith("_partial")]
         if not runs:
             continue
         legs[leg] = {
@@ -288,7 +337,9 @@ def summarize(result: dict) -> dict:
             "steady_state_epoch_s": _agg(runs, "steady_state_epoch_s"),
         }
     summary: dict = {"legs": legs}
-    if "torch" in legs and "tpu_f32" in legs:
+    if "torch" in legs and "tpu_f32" in legs and \
+            legs["torch"]["eval_miou"].get("mean") is not None and \
+            legs["tpu_f32"]["eval_miou"].get("mean") is not None:
         t, j = legs["torch"]["eval_miou"], legs["tpu_f32"]["eval_miou"]
         summary["eval_miou_delta_f32"] = round(j["mean"] - t["mean"], 4)
         # parity iff the mean+-std intervals overlap
@@ -296,7 +347,9 @@ def summarize(result: dict) -> dict:
             j["mean"] + j["std"] >= t["mean"] - t["std"]
             and t["mean"] + t["std"] >= j["mean"] - j["std"]
         )
-    if "torch" in legs and "tpu_bf16" in legs:
+    if "torch" in legs and "tpu_bf16" in legs and \
+            legs["torch"]["eval_miou"].get("mean") is not None and \
+            legs["tpu_bf16"]["eval_miou"].get("mean") is not None:
         t, j = legs["torch"]["eval_miou"], legs["tpu_bf16"]["eval_miou"]
         summary["eval_miou_delta_bf16"] = round(j["mean"] - t["mean"], 4)
         summary["intervals_overlap_bf16"] = bool(
@@ -316,6 +369,8 @@ def summarize(result: dict) -> dict:
 
 def _merge(key: str, value: dict) -> dict:
     result = json.loads(OUT.read_text()) if OUT.exists() else {}
+    # a completed leg supersedes its own in-flight partial record
+    result.pop(f"{key}_partial", None)
     result.setdefault("config", {
         "n_train_images": N_IMAGES, "n_eval_images": N_EVAL_IMAGES,
         "img_size": IMG, "batch_size": BATCH, "epochs": EPOCHS,
@@ -360,6 +415,11 @@ def main() -> None:
     if cmd == "summary":
         result = _merge("summary", {})
         print(json.dumps(result.get("summary", {}), indent=1))
+        return
+    if cmd == "torch_partial":
+        entry = parse_torch_log(Path(sys.argv[2]))
+        _merge(f"torch_seed{entry['seed']}_partial", entry)
+        print(json.dumps(entry, indent=1))
         return
     seed = int(sys.argv[2])
     if cmd == "torch":
