@@ -1,0 +1,72 @@
+"""The bench driver-artifact contract: exactly one parseable JSON result
+line ever reaches stdout, and backend probing fails structured, not with a
+hang or a traceback (round-4 verdict item 1 -- round 4's artifacts were
+lost to exactly these paths)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_emit_state():
+    bench._result_printed = False
+    yield
+    bench._result_printed = False
+
+
+def test_emit_result_prints_exactly_once(capsys):
+    bench._emit_result({"metric": "m", "value": 1.0})
+    bench._emit_result(bench._error_payload("late", "should not appear"))
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    assert json.loads(out[0])["value"] == 1.0
+
+
+def test_error_payload_is_parseable_and_bounded():
+    p = bench._error_payload("tpu_unavailable", "x" * 5000)
+    assert p["error"] == "tpu_unavailable"
+    assert len(p["detail"]) <= 800
+    assert p["value"] == 0.0 and p["unit"] == "frames/sec"
+    json.dumps(p)  # round-trips
+
+
+def test_probe_backend_retries_then_raises(monkeypatch):
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    with pytest.raises(RuntimeError, match="backend unavailable"):
+        bench._probe_backend(attempts=3, timeout_s=1.0)
+    assert len(calls) == 3
+
+
+def test_probe_backend_succeeds_and_handles_empty_stderr(monkeypatch):
+    class Ok:
+        returncode = 0
+        stdout = "2.0 tpu"
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: Ok())
+    bench._probe_backend(attempts=1, timeout_s=1.0)  # no raise
+
+    class Bad:
+        returncode = 1
+        stdout = ""
+        stderr = "\n"  # whitespace-only: the round-4 IndexError regression
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: Bad())
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    with pytest.raises(RuntimeError, match="rc=1"):
+        bench._probe_backend(attempts=2, timeout_s=1.0)
