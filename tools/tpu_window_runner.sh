@@ -13,7 +13,7 @@ probe() {
     # must be the REAL TPU backend: a fast-failing tunnel can drop JAX to
     # the CPU fallback, which would otherwise pass the probe and record
     # CPU timings as TPU results
-    timeout 90 python -c "
+    timeout 75 python -c "
 import jax
 assert jax.default_backend() == 'tpu', jax.default_backend()
 import jax.numpy as jnp
@@ -35,15 +35,38 @@ run_stage() {  # name timeout cmd...
     fi
 }
 
+run_bench() {  # name -- bench.py exits 0 even for its structured error
+    # artifact (by design, for the driver), and can fall back to CPU if
+    # the tunnel flaps mid-init, so stage success here means: a result
+    # line with backend "tpu" and no error. `timeout` targets python
+    # DIRECTLY (a bash -c wrapper would absorb the SIGTERM and orphan a
+    # wedged python holding the tunnel).
+    local name=$1 out="/tmp/${1}_result.json"
+    [ -f "$MARK/$name" ] && return 0
+    log "stage $name: starting"
+    if timeout 2700 python bench.py > "$out" 2>> "/tmp/tpu_stage_$name.log" \
+        && tail -1 "$out" | grep -q '"backend": "tpu"' \
+        && ! tail -1 "$out" | grep -q '"error"'; then
+        touch "$MARK/$name"
+        log "stage $name: DONE"
+        return 0
+    else
+        local rc=$?
+        log "stage $name: failed/timeout/cpu-fallback (rc=$rc)"
+        return 1
+    fi
+}
+
+export BENCH_PROBE_ATTEMPTS=1 BENCH_PROBE_TIMEOUT_S=75
 while true; do
     if [ -f "$MARK/all_done" ]; then log "all done"; exit 0; fi
-    if ! probe; then sleep 45; continue; fi
+    if ! probe; then sleep 20; continue; fi
     log "tunnel healthy; running chain"
-    run_stage bench1 2700 python bench.py || continue
+    run_bench bench1 || continue
     run_stage autotune32 2700 python bench_pallas.py autotune 32 || continue
     run_stage autotune16 1500 python bench_pallas.py autotune 16 || continue
     run_stage pallasbench 3600 python bench_pallas.py || continue
-    run_stage bench2 2700 python bench.py || continue
+    run_bench bench2 || continue
     run_stage parity_f32_s0 3600 env PARITY_PROFILE=r5 \
         python bench_train_parity.py tpu_f32 0 || continue
     run_stage parity_f32_s1 3600 env PARITY_PROFILE=r5 \
