@@ -1,0 +1,41 @@
+"""Static analysis & jit-discipline tooling for the platform.
+
+Three sub-systems, one import surface:
+
+- :mod:`.rules` / :mod:`.linter` -- **jaxlint**, an AST-based linter with
+  JAX/TPU-specific rules (host syncs inside jitted code, Python side
+  effects under jit, bad static_argnums, import-time device compute,
+  device pinning, jit-in-loop). CLI:
+  ``python -m robotic_discovery_platform_tpu.analysis [paths]``.
+- :mod:`.contracts` -- ``@shape_contract`` runtime shape/dtype contracts
+  (chex-backed) applied to the public array APIs. Trace-time cost only
+  under jit; disable entirely with ``RDP_CONTRACTS=0``.
+- :mod:`.recompile` -- the recompilation guard: per-entry-point trace
+  budgets for the hot jitted paths (serving pipeline, train step, Pallas
+  inference), failing loudly (``RDP_RECOMPILE_STRICT=1``) or warning when
+  a hot path retraces beyond its declared budget.
+"""
+
+from robotic_discovery_platform_tpu.analysis.contracts import (
+    ContractError,
+    shape_contract,
+)
+from robotic_discovery_platform_tpu.analysis.linter import (
+    lint_paths,
+    lint_source,
+)
+from robotic_discovery_platform_tpu.analysis.recompile import (
+    RecompileBudgetExceeded,
+    trace_guard,
+)
+from robotic_discovery_platform_tpu.analysis.rules import Finding
+
+__all__ = [
+    "ContractError",
+    "Finding",
+    "RecompileBudgetExceeded",
+    "lint_paths",
+    "lint_source",
+    "shape_contract",
+    "trace_guard",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from robotic_discovery_platform_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,483 @@
+"""jaxlint rule engine: JAX/TPU-aware AST checks for one module.
+
+Every rule answers one question about jit discipline that XLA cannot
+answer for us at runtime without costing frames first:
+
+========  ========  =====================================================
+rule      severity  fires on
+========  ========  =====================================================
+JL001     error     host sync inside jitted code: ``.item()``,
+                    ``.tolist()``, ``float()``/``int()``/``bool()`` on a
+                    traced value, ``np.asarray``/``np.array`` of a traced
+                    value, ``jax.device_get``, ``.block_until_ready()``
+JL002     error     Python side-effect calls under jit: ``print``,
+                    ``time.*`` (they run at TRACE time, i.e. once, and
+                    silently measure/print tracing, not execution)
+JL003     error     mutation of a captured (closure/global) list or dict
+                    under jit -- runs once at trace, then never again
+JL004     error     ``static_argnums``/``static_argnames`` marking a
+                    parameter with a mutable (unhashable) default or an
+                    array annotation -- every call retraces or raises
+JL005     warning   ``jnp``/``jax.lax``/``jax.nn``/``jax.random`` calls
+                    at module import time (device init + a compiled
+                    constant per import)
+JL006     warning   bare device pinning: subscripting
+                    ``jax.devices()``/``jax.local_devices()``
+JL007     error     ``jax.jit`` called inside a loop body -- a fresh jit
+                    cache (and likely a fresh compile) per iteration
+========  ========  =====================================================
+
+"Jitted code" is computed statically: functions decorated with
+``jax.jit``/``jax.pmap``/``pjit`` (bare, or via ``functools.partial``),
+functions later passed by name to one of those, and every function
+nested inside such a function (nested defs run -- or are traced -- as
+part of the enclosing trace).
+
+Findings on a line containing ``# jaxlint: disable`` (optionally
+``=JL001,JL002``) are suppressed at the source.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+ERROR = "error"
+WARNING = "warning"
+
+RULES = {
+    "JL001": "host sync inside jitted code",
+    "JL002": "Python side effect under jit",
+    "JL003": "mutation of captured state under jit",
+    "JL004": "non-hashable or array-valued static argument",
+    "JL005": "jax.numpy computation at module import time",
+    "JL006": "bare device pinning via jax.devices()[i]",
+    "JL007": "jax.jit called inside a loop",
+}
+
+_JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+# attribute reads that yield STATIC metadata, not a traced value
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "at"}
+# jnp-namespace calls that are metadata-only (no device computation)
+_IMPORT_TIME_OK = {
+    "jax.numpy.dtype",
+    "jax.jit",
+    "jax.tree_util.Partial",
+}
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "update", "setdefault", "clear",
+    "pop", "popitem", "remove", "add", "discard",
+}
+_DEVICE_COMPUTE_PREFIXES = (
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.nn.",
+    "jax.random.",
+    "jax.scipy.",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    file: str
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+
+    def render(self) -> str:
+        return (
+            f"{self.file}:{self.line}:{self.col}: "
+            f"{self.rule} [{self.severity}] {self.message}"
+        )
+
+
+class _Aliases:
+    """Map local names to canonical dotted paths via the module's imports."""
+
+    def __init__(self, tree: ast.Module):
+        self.names: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.names[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.names[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Canonical dotted name of an expression, or None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.names.get(node.id, node.id)
+        return ".".join([root] + list(reversed(parts)))
+
+    def canonical(self, node: ast.AST) -> str | None:
+        name = self.resolve(node)
+        if name is None:
+            return None
+        # normalize the common numpy/jnp spellings
+        for prefix, full in (("jnp.", "jax.numpy."), ("np.", "numpy.")):
+            if name.startswith(prefix):
+                return full + name[len(prefix):]
+        return name
+
+
+def _is_jit_wrapper(aliases: _Aliases, node: ast.AST) -> bool:
+    """Is this expression ``jax.jit``-like, possibly via partial(...)?"""
+    name = aliases.canonical(node)
+    if name in _JIT_WRAPPERS or (name or "").endswith((".jit", ".pjit")):
+        return True
+    if isinstance(node, ast.Call):
+        fname = aliases.canonical(node.func)
+        if fname in ("functools.partial", "partial") and node.args:
+            return _is_jit_wrapper(aliases, node.args[0])
+    return False
+
+
+def _jit_function_defs(tree: ast.Module, aliases: _Aliases) -> list[ast.FunctionDef]:
+    """Top-most jitted function defs: decorated, or passed by name to jit."""
+    jitted_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_wrapper(aliases, node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                jitted_names.add(node.args[0].id)
+    roots: list[ast.FunctionDef] = []
+    seen: set[ast.AST] = set()
+
+    def visit(node: ast.AST, inside: bool) -> None:
+        is_def = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if is_def and not inside:
+            jitted = node.name in jitted_names or any(
+                _is_jit_wrapper(aliases, d) for d in node.decorator_list
+            )
+            if jitted and node not in seen:
+                seen.add(node)
+                roots.append(node)
+                inside = True
+        for child in ast.iter_child_nodes(node):
+            visit(child, inside or is_def and node in seen)
+
+    visit(tree, False)
+    return roots
+
+
+class _TracedExprs:
+    """Conservative taint tracking of traced values inside one jit root."""
+
+    def __init__(self, root: ast.FunctionDef, aliases: _Aliases):
+        self.aliases = aliases
+        self.traced: set[str] = set()
+        self.local: set[str] = set()
+        for node in ast.walk(root):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for arg in (
+                    node.args.posonlyargs + node.args.args
+                    + node.args.kwonlyargs
+                ):
+                    self.traced.add(arg.arg)
+                    self.local.add(arg.arg)
+                if node.args.vararg:
+                    self.local.add(node.args.vararg.arg)
+                if node.args.kwarg:
+                    self.local.add(node.args.kwarg.arg)
+                self.local.add(node.name)
+            elif isinstance(node, ast.Lambda):
+                for arg in node.args.args:
+                    self.traced.add(arg.arg)
+                    self.local.add(arg.arg)
+        # one in-order pass over assignments propagates taint far enough
+        # for lint purposes (loops would need a fixpoint; lint errs short)
+        for node in ast.walk(root):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets, value = [node.target], node.value
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                targets, value = [node.target], node.iter
+            else:
+                continue
+            for t in targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        self.local.add(leaf.id)
+                        if value is not None and self.is_traced(value):
+                            self.traced.add(leaf.id)
+
+    def is_traced(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.is_traced(node.value)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            name = self.aliases.canonical(node.func) or ""
+            if name.startswith(_DEVICE_COMPUTE_PREFIXES):
+                return True
+            # method call on a traced value (x.astype(...), x.sum(...))
+            if isinstance(node.func, ast.Attribute):
+                return self.is_traced(node.func.value)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.is_traced(node.left) or self.is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_traced(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_traced(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.is_traced(node.body) or self.is_traced(node.orelse)
+        return False
+
+
+def _check_jit_body(
+    root: ast.FunctionDef, aliases: _Aliases, out: list[Finding], path: str
+) -> None:
+    taint = _TracedExprs(root, aliases)
+
+    def finding(node, rule, severity, msg):
+        out.append(Finding(path, node.lineno, node.col_offset, rule,
+                           severity, msg))
+
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            name = aliases.canonical(node.func) or ""
+            # JL001: explicit host syncs
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in ("item", "tolist") and taint.is_traced(
+                    node.func.value
+                ):
+                    finding(
+                        node, "JL001", ERROR,
+                        f".{attr}() on a traced value inside jitted code "
+                        "forces a host sync at trace and a ConcretizationError "
+                        "under jit",
+                    )
+                elif attr == "block_until_ready":
+                    finding(
+                        node, "JL001", ERROR,
+                        ".block_until_ready() inside jitted code is a host "
+                        "sync (and a no-op on tracers)",
+                    )
+            if name == "jax.device_get":
+                finding(
+                    node, "JL001", ERROR,
+                    "jax.device_get inside jitted code is a host transfer; "
+                    "return the value instead",
+                )
+            elif name in ("float", "int", "bool", "complex") and (
+                len(node.args) == 1 and taint.is_traced(node.args[0])
+            ):
+                finding(
+                    node, "JL001", ERROR,
+                    f"{name}() on a traced value inside jitted code "
+                    "concretizes (ConcretizationError under jit); use "
+                    f"jnp/astype to stay on device",
+                )
+            elif name in ("numpy.asarray", "numpy.array") and (
+                node.args and taint.is_traced(node.args[0])
+            ):
+                finding(
+                    node, "JL001", ERROR,
+                    f"{name.replace('numpy', 'np')} of a traced value pulls "
+                    "it to host; use jnp.asarray to stay in the graph",
+                )
+            # JL002: trace-time side effects
+            elif name == "print":
+                finding(
+                    node, "JL002", ERROR,
+                    "print() under jit runs once at TRACE time, not per "
+                    "call; use jax.debug.print",
+                )
+            elif name.startswith("time.") or name in (
+                "perf_counter", "monotonic",
+            ):
+                finding(
+                    node, "JL002", ERROR,
+                    f"{name}() under jit measures tracing, not execution; "
+                    "time at the call site around block_until_ready",
+                )
+            # JL003: captured-container mutation
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATING_METHODS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id not in taint.local
+            ):
+                finding(
+                    node, "JL003", ERROR,
+                    f"mutating captured {node.func.value.id!r} under jit "
+                    "happens once at trace time and never again; return "
+                    "the value or carry it through the function",
+                )
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id not in taint.local
+                ):
+                    finding(
+                        node, "JL003", ERROR,
+                        f"item assignment into captured {t.value.id!r} under "
+                        "jit happens once at trace time and never again",
+                    )
+
+
+def _static_param_findings(
+    tree: ast.Module, aliases: _Aliases, out: list[Finding], path: str
+) -> None:
+    """JL004: static_argnums/static_argnames pointing at unhashable or
+    array-valued parameters (checked on decorated defs, where the
+    parameter list is visible)."""
+
+    def jit_call_kwargs(dec: ast.AST) -> dict[str, ast.AST]:
+        if isinstance(dec, ast.Call):
+            if _is_jit_wrapper(aliases, dec.func) or _is_jit_wrapper(
+                aliases, dec
+            ):
+                return {k.arg: k.value for k in dec.keywords if k.arg}
+        return {}
+
+    def literal_elems(node: ast.AST) -> list:
+        if isinstance(node, ast.Constant):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [
+                e.value for e in node.elts if isinstance(e, ast.Constant)
+            ]
+        return []
+
+    def is_bad_param(arg: ast.arg, default: ast.AST | None) -> str | None:
+        if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+            return "has a mutable (unhashable) default"
+        ann = ast.unparse(arg.annotation) if arg.annotation else ""
+        if any(t in ann for t in ("ndarray", "Array", "jnp.")):
+            return f"is annotated {ann!r} (arrays are not hashable)"
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        kwargs: dict[str, ast.AST] = {}
+        for dec in node.decorator_list:
+            kwargs.update(jit_call_kwargs(dec))
+        if not kwargs:
+            continue
+        pos = node.args.posonlyargs + node.args.args
+        defaults: dict[str, ast.AST] = {}
+        for arg, d in zip(reversed(pos), reversed(node.args.defaults)):
+            defaults[arg.arg] = d
+        for arg, d in zip(node.args.kwonlyargs, node.args.kw_defaults):
+            if d is not None:
+                defaults[arg.arg] = d
+        by_name = {a.arg: a for a in pos + node.args.kwonlyargs}
+        marked: list[ast.arg] = []
+        for i in literal_elems(kwargs.get("static_argnums", ast.Tuple([], ast.Load()))):
+            if isinstance(i, int) and 0 <= i < len(pos):
+                marked.append(pos[i])
+        for n in literal_elems(kwargs.get("static_argnames", ast.Tuple([], ast.Load()))):
+            if isinstance(n, str) and n in by_name:
+                marked.append(by_name[n])
+        for arg in marked:
+            why = is_bad_param(arg, defaults.get(arg.arg))
+            if why:
+                out.append(Finding(
+                    path, node.lineno, node.col_offset, "JL004", ERROR,
+                    f"static argument {arg.arg!r} of {node.name!r} {why}; "
+                    "static args must be hashable and are compared by "
+                    "equality on every call",
+                ))
+
+
+def _module_level_findings(
+    tree: ast.Module, aliases: _Aliases, out: list[Finding], path: str
+) -> None:
+    """JL005 (import-time device compute) and JL006 (device pinning) and
+    JL007 (jit-in-loop) -- walked over the whole module with the right
+    scoping for each."""
+
+    def walk_module_scope(node: ast.AST):
+        """Yield nodes executed AT IMPORT TIME: module and class bodies,
+        skipping function/lambda bodies and decorator lists."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield child
+            yield from walk_module_scope(child)
+
+    for node in walk_module_scope(tree):
+        if isinstance(node, ast.Call):
+            name = aliases.canonical(node.func) or ""
+            if (
+                name.startswith(_DEVICE_COMPUTE_PREFIXES)
+                and name not in _IMPORT_TIME_OK
+            ):
+                out.append(Finding(
+                    path, node.lineno, node.col_offset, "JL005", WARNING,
+                    f"{name}() at module import time initializes the "
+                    "backend and bakes a device constant per import; move "
+                    "it inside a function or use numpy",
+                ))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript):
+            v = node.value
+            if isinstance(v, ast.Call) and aliases.canonical(v.func) in (
+                "jax.devices", "jax.local_devices",
+            ):
+                out.append(Finding(
+                    path, node.lineno, node.col_offset, "JL006", WARNING,
+                    "bare device pinning (jax.devices()[i]) breaks under "
+                    "meshes and multi-process; thread the device/sharding "
+                    "through configuration",
+                ))
+        elif isinstance(node, (
+            ast.For, ast.While,
+            ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+        )):
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and _is_jit_wrapper(aliases, sub.func)
+                    and sub.args
+                ):
+                    out.append(Finding(
+                        path, sub.lineno, sub.col_offset, "JL007", ERROR,
+                        "jax.jit inside a loop builds a fresh jit cache "
+                        "(and compile) per iteration; hoist the jit out of "
+                        "the loop",
+                    ))
+
+
+def check_module(tree: ast.Module, path: str) -> list[Finding]:
+    """All findings for one parsed module, unsuppressed and unsorted."""
+    aliases = _Aliases(tree)
+    out: list[Finding] = []
+    for root in _jit_function_defs(tree, aliases):
+        _check_jit_body(root, aliases, out, path)
+    _static_param_findings(tree, aliases, out, path)
+    _module_level_findings(tree, aliases, out, path)
+    return out

@@ -26,18 +26,20 @@ TPU-first design departures (deliberate, not omissions):
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 from robotic_discovery_platform_tpu.utils.config import ModelConfig
 
 DType = Any
 
 
+@shape_contract(x="b ih iw c")
 def upsample_align_corners(x, h: int, w: int):
     """Bilinear 2D resize with ``align_corners=True`` sampling -- the exact
     semantics of the reference decoder's ``nn.Upsample(scale_factor=2,

@@ -21,6 +21,8 @@ import functools
 import jax.numpy as jnp
 import numpy as np
 
+from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
+
 # All spline matmuls are tiny ([N, C] with C ~ 16); force full f32 precision
 # so the TPU MXU's default-bf16 f32 matmul does not degrade curvature (second
 # derivatives amplify rounding ~1e-3 relative under bf16 accumulation).
@@ -124,6 +126,7 @@ def bspline_basis_derivative(u, knots, degree: int = 3, order: int = 1):
     return _mm(b, jnp.asarray(m, dtype=b.dtype))
 
 
+@shape_contract(points="n d", weights="n", out="n")
 def chord_length_params(points, weights):
     """Normalized cumulative chord-length parametrization (the ``splprep``
     default, reference: pkg/geometry_utils.py:78) for a *weighted* fixed-shape
@@ -152,6 +155,7 @@ def second_difference_penalty(num_ctrl: int) -> np.ndarray:
     return d2.T @ d2
 
 
+@shape_contract(points="n d", weights="n", knots="k")
 def fit_bspline(points, weights, knots, degree: int = 3, smoothing: float = 1e-3):
     """Weighted penalized least-squares B-spline fit (all shapes static).
 
@@ -183,6 +187,7 @@ def fit_bspline(points, weights, knots, degree: int = 3, smoothing: float = 1e-3
     return ctrl, u
 
 
+@shape_contract(ctrl="c d", knots="k", u="n", out="n d")
 def evaluate_bspline(ctrl, knots, u, degree: int = 3, order: int = 0):
     """Evaluate the spline (or its ``order``-th derivative) at parameters
     ``u``: returns [N, D]."""
@@ -190,6 +195,7 @@ def evaluate_bspline(ctrl, knots, u, degree: int = 3, order: int = 0):
     return _mm(d, ctrl)
 
 
+@shape_contract(ctrl="c d", knots="k", u="n")
 def curvature_profile(ctrl, knots, u, degree: int = 3):
     """kappa(u) = ||r' x r''|| / ||r'||^3 along the fitted curve
     (reference: pkg/geometry_utils.py:144-162), plus the sample points.

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 from robotic_discovery_platform_tpu.ops import bspline
 from robotic_discovery_platform_tpu.utils.config import GeometryConfig
 
@@ -51,6 +52,7 @@ class CurvatureProfile(NamedTuple):
     truncated: jnp.ndarray  # scalar bool: per-bin max_per_bin budget bound
 
 
+@shape_contract(mask="h w", depth="h w")
 def deproject(mask, depth, fx, fy, cx, cy, depth_scale, stride: int = 1):
     """Pinhole deprojection over the dense grid (reference :101-117).
 
@@ -177,6 +179,7 @@ def _sort_by_x(pts, w):
     return pts[order], w[order]
 
 
+@shape_contract(mask="h w", depth="h w", intrinsics="3 3")
 def compute_curvature_profile(
     mask,
     depth,

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
+
 
 def use_pallas() -> bool:
     """Default policy: compiled Pallas on TPU, XLA fallback elsewhere.
@@ -154,6 +156,8 @@ def _conv3x3_kernel(x_ref, w_ref, sb_ref, o_ref, *, tile_h, width, relu,
     o_ref[:] = y.reshape(tile_h, width, tile_co).astype(o_ref.dtype)
 
 
+@shape_contract(x="b h w ci", w="3 3 ci co", scale="co", bias="co",
+                out="b h w co")
 @functools.partial(
     jax.jit, static_argnames=("relu", "out_dtype", "interpret", "tiling")
 )
@@ -279,6 +283,8 @@ def _conv1x1_squeeze_kernel(x_ref, w_ref, sb_ref, o_ref, *, relu):
     o_ref[0] = y.reshape(th, width).astype(o_ref.dtype)
 
 
+@shape_contract(x="b h w ci", w="ci co", scale="co", bias="co",
+                out="b h w co")
 @functools.partial(
     jax.jit, static_argnames=("relu", "out_dtype", "interpret")
 )
@@ -391,6 +397,7 @@ def _convt2x2_kernel(x_ref, w_ref, b_ref, o_ref, *, tile_h, width):
     o_ref[0] = (out + b_ref[0:1, :]).astype(o_ref.dtype)
 
 
+@shape_contract(x="b h w ci", w="2 2 ci co", bias="co")
 @functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
 def conv_transpose2x2(x, w, bias, *, out_dtype=None, interpret: bool = False):
     """NHWC 2x2 stride-2 transposed conv + bias: the reference's

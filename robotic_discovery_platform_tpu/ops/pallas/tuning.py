@@ -25,7 +25,6 @@ from pathlib import Path
 
 from robotic_discovery_platform_tpu.ops.pallas.conv import (
     _VMEM_BUDGET,
-    _lane,
     _tiles_3x3,
     vmem_bytes_3x3,
 )

@@ -18,12 +18,11 @@ Flax module (BatchNorm statistics must update).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from robotic_discovery_platform_tpu.analysis import recompile
 from robotic_discovery_platform_tpu.models.unet import upsample_align_corners
 from robotic_discovery_platform_tpu.ops.pallas import conv as pconv
 
@@ -87,6 +86,13 @@ class PallasUNet:
         params = variables["params"]
         stats = variables.get("batch_stats", {})
         self._layers = self._fold(params, stats)
+        # Per-instance trace budget (analysis/recompile): the serving
+        # engine traces this forward once per camera geometry / batch
+        # bucket through the fused analyzer's jit. traced_only means
+        # eager interpret-mode test calls never consume budget.
+        self._guarded_forward = recompile.trace_guard(
+            "pallas.unet_forward", budget=8
+        )(self._forward)
 
     # -- variable-tree walking ------------------------------------------
 
@@ -169,6 +175,9 @@ class PallasUNet:
     def __call__(self, x):
         """NHWC input -> NHWC f32 logits, same contract as
         ``model.apply(variables, x, train=False)``."""
+        return self._guarded_forward(x)
+
+    def _forward(self, x):
         L = self._layers
         force = self._uniform_force(x)
         x = x.astype(self.model.dtype)

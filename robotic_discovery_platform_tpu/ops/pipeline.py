@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from robotic_discovery_platform_tpu.analysis import recompile
+from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 from robotic_discovery_platform_tpu.ops import geometry
 from robotic_discovery_platform_tpu.utils.config import GeometryConfig
 
@@ -51,6 +53,7 @@ def _resize_matrix(n_in: int, n_out: int) -> np.ndarray:
     return np.where(in_bounds, weights, 0.0).T.astype(np.float32)
 
 
+@shape_contract(frames_rgb="b h w 3", out="b s s 3")
 def preprocess(frames_rgb, img_size: int):
     """uint8 [B, H, W, 3] RGB -> float [B, S, S, 3] in [0, 1].
 
@@ -71,6 +74,7 @@ def preprocess(frames_rgb, img_size: int):
     return jnp.einsum("Pw,bOwc->bOPc", r_w, x, precision="highest")
 
 
+@shape_contract(logits="b s s 1", out="b h w")
 def logits_to_native_masks(logits, h: int, w: int, threshold: float = 0.5):
     """sigmoid > threshold at model resolution, nearest-resize to native
     [B, H, W] (reference: server.py:122-125)."""
@@ -128,7 +132,12 @@ def make_frame_analyzer(
     geometry.
     """
 
+    # trace_guard rides UNDER jit so its body runs once per jit-cache miss:
+    # one compile per camera geometry is the declared steady state (budget 2
+    # tolerates one mid-run camera change before the guard flags).
     @jax.jit
+    @recompile.trace_guard("pipeline.frame_analyzer", budget=2)
+    @shape_contract(frame_rgb="h w 3", depth="h w", intrinsics="3 3")
     def analyze(variables, frame_rgb, depth, intrinsics, depth_scale):
         out = _analyze_batch(
             model,
@@ -164,7 +173,12 @@ def make_batch_analyzer(
     different cameras batch correctly.
     """
 
+    # budget 8: the batching dispatcher pads to power-of-two buckets, so one
+    # camera geometry legitimately compiles ~log2(max_batch)+1 batch shapes
     @jax.jit
+    @recompile.trace_guard("pipeline.batch_analyzer", budget=8)
+    @shape_contract(frames_rgb="b h w 3", depths="b h w",
+                    intrinsics="b 3 3", depth_scales="b")
     def analyze(variables, frames_rgb, depths, intrinsics, depth_scales):
         return _analyze_batch(
             model, variables, frames_rgb, depths,
@@ -199,6 +213,9 @@ def make_scan_batch_analyzer(
     """
 
     @jax.jit
+    @recompile.trace_guard("pipeline.scan_batch_analyzer", budget=8)
+    @shape_contract(frames_rgb="b h w 3", depths="b h w",
+                    intrinsics="b 3 3", depth_scales="b")
     def analyze(variables, frames_rgb, depths, intrinsics, depth_scales):
         intr = jnp.asarray(intrinsics, jnp.float32)
         scales = jnp.asarray(depth_scales, jnp.float32)

@@ -1,3 +1,8 @@
+from robotic_discovery_platform_tpu.parallel.dp import (
+    parallelize_training,
+    put_global_batch,
+    shard_map_train_step,
+)
 from robotic_discovery_platform_tpu.parallel.mesh import (
     AXES,
     batch_sharding,
@@ -6,11 +11,6 @@ from robotic_discovery_platform_tpu.parallel.mesh import (
     replicated,
     shard_pytree,
     tp_param_specs,
-)
-from robotic_discovery_platform_tpu.parallel.dp import (
-    parallelize_training,
-    put_global_batch,
-    shard_map_train_step,
 )
 
 __all__ = [

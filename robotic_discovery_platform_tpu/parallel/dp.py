@@ -23,6 +23,7 @@ import jax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from robotic_discovery_platform_tpu.analysis import recompile
 from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
 
 
@@ -128,13 +129,17 @@ def parallelize_training(
     sharded_state = jax.tree.map(jax.device_put, state, state_shardings)
 
     train = jax.jit(
-        core_train_step(model, tx, loss_fn),
+        recompile.trace_guard("parallel.train_step", budget=3)(
+            core_train_step(model, tx, loss_fn)
+        ),
         in_shardings=(state_shardings, batch_sh, batch_sh),
         out_shardings=(state_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,) if donate else (),
     )
     evals = jax.jit(
-        core_eval_step(model, loss_fn),
+        recompile.trace_guard("parallel.eval_step", budget=3)(
+            core_eval_step(model, loss_fn)
+        ),
         in_shardings=(state_shardings, batch_sh, batch_sh),
         out_shardings=NamedSharding(mesh, P()),
     )
@@ -188,4 +193,9 @@ def shard_map_train_step(mesh: Mesh, model, tx, loss_fn: Callable,
         )
         return mapped(state, x, y)
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return jax.jit(
+        recompile.trace_guard("parallel.shard_map_train_step", budget=3)(
+            step
+        ),
+        donate_argnums=(0,) if donate else (),
+    )

@@ -21,9 +21,8 @@ over DCN.
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from robotic_discovery_platform_tpu.utils.config import MeshConfig

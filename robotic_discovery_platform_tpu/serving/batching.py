@@ -26,6 +26,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -78,6 +79,8 @@ class BatchDispatcher:
 
     # -- caller side --------------------------------------------------------
 
+    @shape_contract(frame_rgb=("h w 3", "uint8"), depth="h w",
+                    intrinsics="3 3")
     def submit(self, frame_rgb, depth, intrinsics, depth_scale):
         """Block until this frame's analysis is available; returns the
         unbatched FrameAnalysis slice (host numpy leaves)."""

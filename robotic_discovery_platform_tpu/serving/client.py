@@ -12,13 +12,11 @@ tests, benches, and batch jobs can consume the same path.
 
 from __future__ import annotations
 
-import queue
 from collections import deque
-from dataclasses import dataclass, field
-
-import numpy as np
+from dataclasses import dataclass
 
 import grpc
+import numpy as np
 
 from robotic_discovery_platform_tpu.io.frames import (
     FrameSource,

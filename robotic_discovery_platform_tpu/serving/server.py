@@ -23,22 +23,21 @@ import time
 from concurrent import futures
 from typing import Any, NamedTuple
 
-import numpy as np
-
 import grpc
 import jax
+import numpy as np
 
 from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.io.frames import load_calibration
 from robotic_discovery_platform_tpu.ops import pipeline
 from robotic_discovery_platform_tpu.serving.metrics import MetricsWriter
-from robotic_discovery_platform_tpu.utils.profiling import StageTimer
 from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
 from robotic_discovery_platform_tpu.utils.config import (
     GeometryConfig,
     ServerConfig,
 )
 from robotic_discovery_platform_tpu.utils.logging import get_logger
+from robotic_discovery_platform_tpu.utils.profiling import StageTimer
 
 log = get_logger(__name__)
 

@@ -30,6 +30,7 @@ import optax
 from flax import struct
 
 from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.analysis import recompile
 from robotic_discovery_platform_tpu.models import losses as losses_lib
 from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
 from robotic_discovery_platform_tpu.training import data as data_lib
@@ -93,9 +94,16 @@ def core_train_step(model, tx, loss_fn: Callable):
 
 
 def make_train_step(model, tx, loss_fn: Callable, donate: bool = True):
-    """Single-device jitted train step."""
+    """Single-device jitted train step.
+
+    Trace-budgeted (analysis/recompile): the steady state is ONE compile;
+    budget 3 tolerates the legitimate extra shapes (a trailing partial
+    batch, a resume with a different batch size) before the guard flags a
+    retrace leak."""
     return jax.jit(
-        core_train_step(model, tx, loss_fn),
+        recompile.trace_guard("trainer.train_step", budget=3)(
+            core_train_step(model, tx, loss_fn)
+        ),
         donate_argnums=(0,) if donate else (),
     )
 
@@ -119,7 +127,11 @@ def core_eval_step(model, loss_fn: Callable):
 
 
 def make_eval_step(model, loss_fn: Callable):
-    return jax.jit(core_eval_step(model, loss_fn))
+    return jax.jit(
+        recompile.trace_guard("trainer.eval_step", budget=3)(
+            core_eval_step(model, loss_fn)
+        )
+    )
 
 
 def make_epoch_runners(model, tx, loss_fn: Callable, donate: bool = True):
@@ -158,8 +170,15 @@ def make_epoch_runners(model, tx, loss_fn: Callable, donate: bool = True):
         return jax.tree.map(jnp.mean, metrics)
 
     return (
-        jax.jit(train_epoch, donate_argnums=(0,) if donate else ()),
-        jax.jit(eval_epoch),
+        jax.jit(
+            recompile.trace_guard("trainer.train_epoch", budget=2)(
+                train_epoch
+            ),
+            donate_argnums=(0,) if donate else (),
+        ),
+        jax.jit(recompile.trace_guard("trainer.eval_epoch", budget=2)(
+            eval_epoch
+        )),
     )
 
 

@@ -15,7 +15,6 @@ is the contract get_alias/get_or_create_experiment branch on.
 from __future__ import annotations
 
 import json
-import posixpath
 import threading
 import time
 import uuid

@@ -1,10 +1,9 @@
 """Unit tests for the fixed-knot B-spline engine against scipy ground truth."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from scipy.interpolate import BSpline
-
-import jax.numpy as jnp
 
 from robotic_discovery_platform_tpu.ops import bspline
 

@@ -1,14 +1,12 @@
 """Geometry pipeline tests: analytic arcs, reference-semantics oracle parity,
 graceful-zero behavior, and jit-compilability."""
 
-import numpy as np
-import jax
 import jax.numpy as jnp
+import numpy as np
+from oracle import make_arc_scene, oracle_curvature
 
 from robotic_discovery_platform_tpu.ops import geometry
 from robotic_discovery_platform_tpu.utils.config import GeometryConfig
-
-from oracle import make_arc_scene, oracle_curvature
 
 
 def test_deproject_matches_pinhole():

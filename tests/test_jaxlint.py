@@ -1,0 +1,321 @@
+"""jaxlint fixture tests: every rule fires on a known-bad snippet and
+stays silent on the idiomatic equivalent."""
+
+import json
+import textwrap
+
+import pytest
+
+from robotic_discovery_platform_tpu.analysis import lint_source
+from robotic_discovery_platform_tpu.analysis.cli import main as cli_main
+from robotic_discovery_platform_tpu.analysis.linter import lint_paths
+
+# (rule, bad snippet, idiomatic-equivalent snippet)
+CASES = [
+    (
+        "JL001",  # float() on a traced value under jit
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.sum(x)
+            return float(y)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.sum(x)
+
+        def caller(x):
+            return float(f(x))
+        """,
+    ),
+    (
+        "JL001",  # np.asarray of a traced value under jit
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x) + 1
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return jnp.asarray(x) + 1
+        """,
+    ),
+    (
+        "JL001",  # .item() host sync under jit
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.mean().item()
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.mean()
+        """,
+    ),
+    (
+        "JL002",  # print at trace time
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            print("x is", x)
+            return x
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            jax.debug.print("x is {x}", x=x)
+            return x
+        """,
+    ),
+    (
+        "JL002",  # time.* measures tracing, not execution
+        """
+        import time
+
+        import jax
+
+        @jax.jit
+        def f(x):
+            t0 = time.perf_counter()
+            return x, t0
+        """,
+        """
+        import time
+
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x
+
+        def timed(x):
+            t0 = time.perf_counter()
+            return f(x).block_until_ready(), time.perf_counter() - t0
+        """,
+    ),
+    (
+        "JL003",  # captured-list mutation runs once, at trace
+        """
+        import jax
+
+        acc = []
+
+        @jax.jit
+        def f(x):
+            acc.append(x)
+            return x
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            ys = []
+            for i in range(3):
+                ys.append(x * i)
+            return ys[0] + ys[1] + ys[2]
+        """,
+    ),
+    (
+        "JL004",  # unhashable static argument
+        """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(x, sizes=[]):
+            return x
+        """,
+        """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(x, n=2):
+            return x * n
+        """,
+    ),
+    (
+        "JL005",  # device compute at import time
+        """
+        import jax.numpy as jnp
+
+        ZEROS = jnp.zeros((8,))
+        """,
+        """
+        import numpy as np
+
+        ZEROS = np.zeros((8,))
+        """,
+    ),
+    (
+        "JL006",  # bare device pinning
+        """
+        import jax
+
+        DEVICE = jax.devices()[0]
+        """,
+        """
+        import jax
+
+        N_DEVICES = len(jax.devices())
+        """,
+    ),
+    (
+        "JL007",  # fresh jit cache per loop iteration
+        """
+        import jax
+
+        def run(xs):
+            outs = []
+            for x in xs:
+                outs.append(jax.jit(lambda a: a + 1)(x))
+            return outs
+        """,
+        """
+        import jax
+
+        g = jax.jit(lambda a: a + 1)
+
+        def run(xs):
+            return [g(x) for x in xs]
+        """,
+    ),
+]
+
+
+def _rules(src: str) -> set:
+    return {f.rule for f in lint_source(textwrap.dedent(src))}
+
+
+@pytest.mark.parametrize(
+    "rule,bad,good", CASES, ids=[f"{c[0]}-{i}" for i, c in enumerate(CASES)]
+)
+def test_rule_fires_on_bad_and_not_on_good(rule, bad, good):
+    assert rule in _rules(bad), f"{rule} must fire on the bad snippet"
+    assert rule not in _rules(good), f"{rule} fired on the idiomatic snippet"
+
+
+def test_at_least_six_distinct_rules_covered():
+    assert len({rule for rule, _, _ in CASES}) >= 6
+
+
+def test_inline_suppression():
+    src = textwrap.dedent(
+        """
+        import jax.numpy as jnp
+
+        ZEROS = jnp.zeros((8,))  # jaxlint: disable=JL005
+        """
+    )
+    assert lint_source(src) == []
+    # a disable for a different rule does not suppress
+    src_wrong = src.replace("JL005", "JL001")
+    assert {f.rule for f in lint_source(src_wrong)} == {"JL005"}
+
+
+BAD_MODULE = textwrap.dedent(
+    """
+    import jax
+
+    @jax.jit
+    def f(x):
+        print(x)
+        return x
+    """
+)
+
+
+def test_baseline_suppresses_with_justification(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(BAD_MODULE)
+    line = next(f.line for f in lint_source(BAD_MODULE, str(mod)))
+    baseline = tmp_path / ".jaxlint-baseline.json"
+    baseline.write_text(json.dumps({
+        "version": 1,
+        "entries": [{
+            "file": str(mod), "rule": "JL002", "line": line,
+            "justification": "fixture: known trace-time print",
+        }],
+    }))
+    result = lint_paths([str(tmp_path)], baseline_path=baseline)
+    assert result.findings == []
+    assert len(result.baselined) == 1
+    assert result.stale_baseline == []
+
+
+def test_baseline_requires_justification(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(BAD_MODULE)
+    baseline = tmp_path / ".jaxlint-baseline.json"
+    baseline.write_text(json.dumps({
+        "version": 1,
+        "entries": [
+            {"file": str(mod), "rule": "JL002", "line": 6,
+             "justification": ""},
+        ],
+    }))
+    with pytest.raises(ValueError, match="justification"):
+        lint_paths([str(tmp_path)], baseline_path=baseline)
+
+
+def test_stale_baseline_entries_are_reported(tmp_path):
+    mod = tmp_path / "clean.py"
+    mod.write_text("import numpy as np\nX = np.zeros((2,))\n")
+    baseline = tmp_path / ".jaxlint-baseline.json"
+    baseline.write_text(json.dumps({
+        "version": 1,
+        "entries": [{"file": str(mod), "rule": "JL005", "line": 2,
+                     "justification": "was real once"}],
+    }))
+    result = lint_paths([str(tmp_path)], baseline_path=baseline)
+    assert result.findings == []
+    assert len(result.stale_baseline) == 1
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_MODULE)
+    clean = tmp_path / "clean.py"
+    clean.write_text("import numpy as np\nX = np.zeros((2,))\n")
+    assert cli_main([str(clean), "--no-baseline"]) == 0
+    assert cli_main([str(bad), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "JL002" in out
+    # warnings alone do not fail (JL005 is warning severity)...
+    warn = tmp_path / "warn.py"
+    warn.write_text("import jax.numpy as jnp\nZ = jnp.zeros((4,))\n")
+    assert cli_main([str(warn), "--no-baseline"]) == 0
+    # ...unless promoted
+    assert cli_main([str(warn), "--no-baseline", "--strict-warnings"]) == 1
+
+
+def test_cli_runs_clean_on_the_package():
+    """The acceptance gate: the analyzer exits 0 over the shipped package
+    with the checked-in (possibly empty) baseline."""
+    assert cli_main(["robotic_discovery_platform_tpu"]) == 0

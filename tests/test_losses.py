@@ -1,8 +1,8 @@
 """Loss/metric correctness against torch (BCE parity) and hand-computed
 values."""
 
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 import torch
 import torch.nn.functional as F
 

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from fake_mlflow_server import FakeMlflowServer
 
 from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
@@ -22,8 +23,6 @@ from robotic_discovery_platform_tpu.tracking.rest_backend import (
     RestMlflowStore,
 )
 from robotic_discovery_platform_tpu.utils.config import ModelConfig
-
-from fake_mlflow_server import FakeMlflowServer
 
 
 def _mlflow_installed() -> bool:

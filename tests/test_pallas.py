@@ -7,11 +7,10 @@ pkg/segmentation_model.py:24-40 (DoubleConv), :54-65 (Up/ConvTranspose),
 :78-84 (OutConv).
 """
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 from flax import linen as nn
 
 from robotic_discovery_platform_tpu.models.unet import DoubleConv, UNet, init_unet

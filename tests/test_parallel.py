@@ -1,7 +1,6 @@
 """Mesh/sharding/collective tests on the 8-device virtual CPU backend --
 the multi-chip CI idiom (SURVEY.md section 4d)."""
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

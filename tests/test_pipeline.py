@@ -1,14 +1,13 @@
 """Fused frame-analysis graph tests."""
 
-import numpy as np
 import jax
 import jax.numpy as jnp
+import numpy as np
+from oracle import make_arc_scene
 
 from robotic_discovery_platform_tpu.models.unet import UNet
 from robotic_discovery_platform_tpu.ops import pipeline
 from robotic_discovery_platform_tpu.utils.config import GeometryConfig
-
-from oracle import make_arc_scene
 
 
 def _small_model_and_vars():

@@ -4,7 +4,6 @@ time but never measures anything)."""
 
 import time
 
-import jax
 import jax.numpy as jnp
 
 from robotic_discovery_platform_tpu.utils.profiling import StageTimer, jax_trace

@@ -1,0 +1,102 @@
+"""Recompilation-guard tests: the serving pipeline's jitted entry traces
+exactly once for a steady same-shape workload, and a shape-churning
+workload without a declared budget fails loudly under strict mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.analysis import recompile
+from robotic_discovery_platform_tpu.models.unet import UNet
+from robotic_discovery_platform_tpu.ops import pipeline
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    recompile.reset()
+    yield
+    recompile.reset()
+
+
+def _tiny_model_and_vars(img=32):
+    model = UNet(base_features=8, dtype=jnp.float32)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, img, img, 3)), train=False
+    )
+    return model, variables
+
+
+def test_serving_pipeline_compiles_exactly_once_for_same_shape_calls():
+    """N >= 3 same-shape frames through the fused frame analyzer must hit
+    the jit cache after the first call: exactly ONE trace."""
+    model, variables = _tiny_model_and_vars()
+    analyze = pipeline.make_frame_analyzer(model, img_size=32)
+    frame = np.zeros((48, 64, 3), np.uint8)
+    depth = np.full((48, 64), 500, np.uint16)
+    k = np.eye(3, dtype=np.float32)
+    for _ in range(4):
+        out = analyze(variables, frame, depth, k, np.float32(0.001))
+    assert out.mask.shape == (48, 64)
+    assert recompile.total_traces("pipeline.frame_analyzer") == 1
+    assert recompile.over_budget() == {}
+
+
+def test_shape_churn_without_declared_budget_fails_strict():
+    """An undeclared hot path gets DEFAULT_BUDGET (1): the second distinct
+    shape is a retrace over budget and strict mode raises."""
+    f = jax.jit(recompile.trace_guard("test.undeclared")(lambda x: x * 2))
+    with recompile.strict():
+        f(jnp.ones((4,)))
+        with pytest.raises(recompile.RecompileBudgetExceeded,
+                           match="test.undeclared"):
+            f(jnp.ones((5,)))
+    assert recompile.total_traces("test.undeclared") == 2
+
+
+def test_non_strict_mode_warns_but_does_not_raise(caplog):
+    f = jax.jit(recompile.trace_guard("test.warny")(lambda x: x + 1))
+    with recompile.strict(False):
+        f(jnp.ones((2,)))
+        f(jnp.ones((3,)))  # over budget: warn only
+    assert recompile.over_budget() == {"test.warny": 1}
+
+
+def test_declared_budget_allows_the_declared_shape_set():
+    f = jax.jit(
+        recompile.trace_guard("test.buckets", budget=3)(lambda x: x + 1)
+    )
+    with recompile.strict():
+        for n in (1, 2, 4):  # three bucket shapes, within budget
+            f(jnp.ones((n, 2)))
+        with pytest.raises(recompile.RecompileBudgetExceeded):
+            f(jnp.ones((8, 2)))
+
+
+def test_eager_calls_do_not_consume_budget():
+    g = recompile.trace_guard("test.eager")(lambda x: x + 1)
+    for n in range(1, 5):
+        g(jnp.ones((n,)))  # eager: no tracers, no counting
+    assert recompile.total_traces("test.eager") == 0
+
+
+def test_snapshot_reports_shapes():
+    f = jax.jit(recompile.trace_guard("test.snap", budget=2)(lambda x: x))
+    f(jnp.ones((3,)))
+    snap = recompile.snapshot()["test.snap"]
+    assert snap[0]["traces"] == 1
+    assert "float32[3]" in snap[0]["shapes"][0]
+
+
+def test_hot_reload_instances_budget_independently():
+    """Two engines (hot reload) register under one name; each instance
+    carries its own budget, and totals aggregate."""
+    mk = lambda: jax.jit(
+        recompile.trace_guard("test.engine", budget=1)(lambda x: x + 1)
+    )
+    a, b = mk(), mk()
+    with recompile.strict():
+        a(jnp.ones((2,)))
+        b(jnp.ones((2,)))  # a fresh jit cache: its own single trace is fine
+    assert recompile.total_traces("test.engine") == 2
+    assert recompile.over_budget() == {}

@@ -16,7 +16,6 @@ from robotic_discovery_platform_tpu.serving.metrics import HEADER, MetricsWriter
 from robotic_discovery_platform_tpu.serving.proto import vision_pb2
 from robotic_discovery_platform_tpu.utils.config import (
     ClientConfig,
-    GeometryConfig,
     ModelConfig,
     ServerConfig,
 )

@@ -13,10 +13,9 @@ reference checkpoint imports and serves unchanged
 import sys
 from pathlib import Path
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
-
-import jax.numpy as jnp
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
@@ -61,7 +60,6 @@ def test_convtranspose_import_flip():
     """Flax nn.ConvTranspose stores the kernel spatially flipped relative to
     torch.nn.ConvTranspose2d; the importer's HWIO transpose + [::-1, ::-1]
     must make the two layers agree exactly."""
-    import jax
     from flax import linen as nn
 
     torch.manual_seed(1)

@@ -2,7 +2,6 @@
 lifecycle: train-run logging -> model registration -> staging alias ->
 models:/ uri resolution."""
 
-import json
 
 import jax
 import jax.numpy as jnp

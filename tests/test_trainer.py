@@ -1,7 +1,6 @@
 """Trainer tests: end-to-end train->track->register on synthetic data,
 loss descent, checkpoint resume."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
