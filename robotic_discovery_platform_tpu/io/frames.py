@@ -25,6 +25,11 @@ from typing import Iterator, Protocol
 
 import numpy as np
 
+from robotic_discovery_platform_tpu.resilience import RetryPolicy
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
 
 class FrameSource(Protocol):
     """A source of aligned (color_bgr_u8 [H,W,3], depth_u16 [H,W]) pairs."""
@@ -133,10 +138,18 @@ class RealSenseSource:
     lock (the reference shares the live depth-frame handle across threads).
     """
 
-    def __init__(self, width: int = 640, height: int = 480, fps: int = 30):
+    def __init__(self, width: int = 640, height: int = 480, fps: int = 30,
+                 retry: RetryPolicy | None = None):
         import pyrealsense2 as rs  # hardware-gated
 
         self._rs = rs
+        # Disconnect/reconnect backoff on the shared RetryPolicy (the old
+        # hand-rolled loop slept a flat 0.1 s, hammering a truly-gone
+        # camera 10x/s forever): unlimited attempts -- a camera CAN come
+        # back -- with capped jittered exponential backoff.
+        self._retry = retry or RetryPolicy(
+            max_attempts=None, base_delay_s=0.1, max_delay_s=2.0,
+        )
         self.width, self.height, self.fps = width, height, fps
         self._pipeline = rs.pipeline()
         self._config = rs.config()
@@ -161,6 +174,7 @@ class RealSenseSource:
         self._thread.start()
 
     def _read_loop(self) -> None:
+        backoff = None
         while not self._stopped.is_set():
             try:
                 frames = self._pipeline.wait_for_frames()
@@ -175,10 +189,19 @@ class RealSenseSource:
                 )
                 with self._lock:
                     self._latest = pair
-            except RuntimeError:
-                # camera disconnect: back off and retry (reference
-                # camera.py:112-115)
-                time.sleep(0.1)
+                backoff = None  # healthy: the next outage starts from base
+            except RuntimeError as exc:
+                # camera disconnect (reference camera.py:112-115): jittered
+                # exponential backoff from the shared policy, slept on the
+                # stop event so stop() stays responsive mid-backoff
+                if backoff is None:
+                    backoff = self._retry.delays()
+                delay = next(backoff)
+                log.warning(
+                    "camera read failed (%s); reconnecting in %.2fs",
+                    exc, delay,
+                )
+                self._stopped.wait(delay)
 
     def stop(self) -> None:
         self._stopped.set()

@@ -15,6 +15,24 @@ pads each group up to the next power-of-two bucket (so XLA compiles a handful
 of batch shapes, not one per group size), runs the batched fused graph, and
 fans results back out. Padding frames are replicas of the first frame and
 their results are dropped.
+
+Resilience (resilience/ package):
+
+- the queue is *bounded*: a submit arriving with ``max_backlog`` frames
+  already waiting fast-fails with :class:`OverloadedError` (the server maps
+  it to RESOURCE_EXHAUSTED) instead of growing latency without bound;
+- every submit carries a deadline (``submit_timeout_s``, or the caller's
+  tighter one) instead of the old unbounded ``done.wait()`` -- a handler
+  thread can no longer be parked forever;
+- a watchdog notices a collector thread that died *outside* ``_run_group``'s
+  guard (the one hole in the old design: pending events were never set and
+  every submitter hung), error-completes the stranded frames, and restarts
+  the collector.
+
+Fault-injection sites (resilience/faults.py): ``serving.batch.collect``
+fires in the collector loop outside the dispatch guard (chaos tests kill the
+collector here), ``serving.batch.dispatch`` fires inside the guard (failed /
+slow batched dispatches).
 """
 
 from __future__ import annotations
@@ -27,12 +45,19 @@ from typing import Any, Callable
 import numpy as np
 
 from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
+from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 
-@dataclass
+class OverloadedError(RuntimeError):
+    """The dispatcher's backlog cap was hit; the frame was shed, not
+    queued. Retryable by the client (the server surfaces it as
+    RESOURCE_EXHAUSTED)."""
+
+
+@dataclass(eq=False)  # identity semantics: instances live in _pending sets
 class _Pending:
     frame_rgb: np.ndarray
     depth: np.ndarray
@@ -62,28 +87,62 @@ class BatchDispatcher:
             co-arriving frames. The reference's dead ``batch_window_ms`` knob
             (round-1 review) is live here.
         max_batch: hard cap per dispatch.
+        max_backlog: queued-frame cap; submits beyond it shed load
+            (:class:`OverloadedError`) instead of queuing.
+        submit_timeout_s: default per-submit deadline; ``submit`` raises
+            ``DeadlineExceeded`` when the result is not back in time.
+        watchdog_interval_s: how often the watchdog checks collector
+            liveness (<= 0 disables the watchdog).
     """
 
     def __init__(self, analyze_batch: Callable, window_ms: float = 2.0,
-                 max_batch: int = 8):
+                 max_batch: int = 8, max_backlog: int = 64,
+                 submit_timeout_s: float = 30.0,
+                 watchdog_interval_s: float = 1.0):
         self._analyze = analyze_batch
         self._window_s = window_ms / 1e3
         self._max_batch = max_batch
+        self._max_backlog = max_backlog
+        self._submit_timeout_s = submit_timeout_s
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._stopped = threading.Event()
         self._submit_lock = threading.Lock()
-        self._thread = threading.Thread(
+        # every not-yet-completed submit, whether still queued or already
+        # popped by the collector: the watchdog error-completes exactly this
+        # set when the collector dies, so a frame caught between _collect()
+        # and _run_group() is covered too
+        self._pending: set[_Pending] = set()
+        self._pending_lock = threading.Lock()
+        self.collector_restarts = 0
+        self._thread = self._start_collector()
+        self._watchdog: threading.Thread | None = None
+        if watchdog_interval_s > 0:
+            self._watchdog = threading.Thread(
+                target=self._watch, args=(watchdog_interval_s,),
+                name="batch-dispatcher-watchdog", daemon=True,
+            )
+            self._watchdog.start()
+
+    def _start_collector(self) -> threading.Thread:
+        t = threading.Thread(
             target=self._loop, name="batch-dispatcher", daemon=True
         )
-        self._thread.start()
+        t.start()
+        return t
 
     # -- caller side --------------------------------------------------------
 
     @shape_contract(frame_rgb=("h w 3", "uint8"), depth="h w",
                     intrinsics="3 3")
-    def submit(self, frame_rgb, depth, intrinsics, depth_scale):
+    def submit(self, frame_rgb, depth, intrinsics, depth_scale,
+               timeout_s: float | None = None):
         """Block until this frame's analysis is available; returns the
-        unbatched FrameAnalysis slice (host numpy leaves)."""
+        unbatched FrameAnalysis slice (host numpy leaves).
+
+        Raises :class:`OverloadedError` when the backlog cap is hit and
+        ``DeadlineExceeded`` when the result misses the submit deadline
+        (``timeout_s`` if given and tighter, else ``submit_timeout_s``).
+        """
         p = _Pending(frame_rgb, depth, np.asarray(intrinsics, np.float32),
                      float(depth_scale))
         # enqueue under the lock stop() drains under: a submit either lands
@@ -93,8 +152,26 @@ class BatchDispatcher:
         with self._submit_lock:
             if self._stopped.is_set():
                 raise RuntimeError("dispatcher stopped")
+            if self._q.qsize() >= self._max_backlog:
+                raise OverloadedError(
+                    f"dispatcher backlog at cap ({self._max_backlog} "
+                    "frames queued); shedding load"
+                )
+            with self._pending_lock:
+                self._pending.add(p)
             self._q.put(p)
-        p.done.wait()
+        timeout = self._submit_timeout_s
+        if timeout_s is not None:
+            timeout = min(timeout, timeout_s)
+        try:
+            if not p.done.wait(timeout):
+                raise DeadlineExceeded(
+                    f"batched analysis not ready within {timeout:.2f}s "
+                    "(per-submit deadline)"
+                )
+        finally:
+            with self._pending_lock:
+                self._pending.discard(p)
         if p.error is not None:
             raise p.error
         return p.result
@@ -107,6 +184,8 @@ class BatchDispatcher:
             self._stopped.set()
             self._q.put(None)
         self._thread.join(timeout=5)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
         # error-complete anything the collector left behind
         while True:
             try:
@@ -116,6 +195,47 @@ class BatchDispatcher:
             if item is not None and not item.done.is_set():
                 item.error = RuntimeError("dispatcher stopped")
                 item.done.set()
+        self._fail_pending(RuntimeError("dispatcher stopped"))
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        with self._pending_lock:
+            stranded = [p for p in self._pending if not p.done.is_set()]
+        for p in stranded:
+            p.error = exc
+            p.done.set()
+
+    # -- watchdog ------------------------------------------------------------
+
+    def _watch(self, interval_s: float) -> None:
+        """Error-complete and restart if the collector ever dies outside
+        ``_run_group``'s guard (e.g. an exception in the grouping /
+        collection code itself): without this, every in-flight submitter
+        of that era would wait out its full deadline for nothing, and all
+        later submits would queue into a threadless dispatcher."""
+        while not self._stopped.wait(interval_s):
+            if self._thread.is_alive():
+                continue
+            with self._submit_lock:
+                if self._stopped.is_set():
+                    return
+                self.collector_restarts += 1
+                log.error(
+                    "batch collector thread died unexpectedly; failing %d "
+                    "pending frame(s) and restarting (restart #%d)",
+                    len(self._pending), self.collector_restarts,
+                )
+                # drain whatever is queued (the restarted collector starts
+                # from an empty backlog; stranded submitters get an error
+                # now, not a deadline timeout later)
+                while True:
+                    try:
+                        self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                self._fail_pending(
+                    RuntimeError("batch collector died; frame dropped")
+                )
+                self._thread = self._start_collector()
 
     # -- collector side -----------------------------------------------------
 
@@ -143,6 +263,10 @@ class BatchDispatcher:
             batch = self._collect()
             if not batch:
                 continue
+            # deliberately OUTSIDE _run_group's guard: an injected fault
+            # here kills the collector thread itself, which is exactly the
+            # failure mode the watchdog exists for
+            inject("serving.batch.collect")
             by_shape: dict[tuple, list[_Pending]] = {}
             for p in batch:
                 by_shape.setdefault(p.frame_rgb.shape[:2], []).append(p)
@@ -151,6 +275,7 @@ class BatchDispatcher:
 
     def _run_group(self, group: list[_Pending]) -> None:
         try:
+            inject("serving.batch.dispatch")
             n = len(group)
             b = _bucket(n, self._max_batch)
             pad = b - n

@@ -12,6 +12,7 @@ tests, benches, and batch jobs can consume the same path.
 
 from __future__ import annotations
 
+import dataclasses
 from collections import deque
 from dataclasses import dataclass
 
@@ -24,6 +25,7 @@ from robotic_discovery_platform_tpu.io.frames import (
     iter_frames,
     load_calibration,
 )
+from robotic_discovery_platform_tpu.resilience import RetryPolicy, inject
 from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
 from robotic_discovery_platform_tpu.utils.config import ClientConfig
 from robotic_discovery_platform_tpu.utils.logging import get_logger
@@ -102,10 +104,20 @@ def run_client(
     max_frames: int | None = None,
     display: bool = False,
     channel: grpc.Channel | None = None,
+    retry: RetryPolicy | None = None,
 ) -> list[FrameResult]:
     """Stream frames, return per-frame results. ``display=True`` opens the
-    live overlay window ('q' quits, reference client.py:138-140)."""
+    live overlay window ('q' quits, reference client.py:138-140).
+
+    Stream SETUP rides the shared RetryPolicy: UNAVAILABLE before the
+    first response (server restarting, port not up yet) backs off and
+    reopens the stream from a reset source. Once any response has
+    arrived the stream is stateful (smoothing windows, frame pairing) and
+    a failure surfaces to the caller instead of silently re-streaming.
+    """
     source = source or SyntheticSource()
+    retry = retry or RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                                 max_delay_s=2.0)
     intrinsics = dist = None
     try:
         intrinsics, dist, _ = load_calibration(cfg.calibration_path)
@@ -125,7 +137,9 @@ def run_client(
     results: list[FrameResult] = []
 
     source.start()
-    try:
+
+    def stream_once():
+        inject("client.stream")
         responses = stub.AnalyzeActuatorPerformance(
             generate_requests(source, frame_queue, max_frames)
         )
@@ -155,6 +169,26 @@ def run_client(
                            overlay(frame, result, intrinsics, dist))
                 if cv2.waitKey(1) & 0xFF == ord("q"):
                     break
+
+    def setup_retryable(exc: BaseException) -> bool:
+        # only pre-first-response failures the policy itself would retry
+        return not results and retry.retryable(exc)
+
+    def on_retry(attempt: int, exc: BaseException, delay: float) -> None:
+        code = exc.code() if hasattr(exc, "code") else exc
+        log.warning("stream setup to %s failed (%s); retry %d in %.2fs",
+                    cfg.server_address, code, attempt, delay)
+        # restart the (deterministic) source and drop stale pairing state
+        # so the re-opened stream begins from frame 0 again
+        frame_queue.clear()
+        mean_window.clear()
+        max_window.clear()
+        source.start()
+
+    try:
+        dataclasses.replace(retry, retryable=setup_retryable).call(
+            stream_once, on_retry=on_retry
+        )
     except grpc.RpcError as exc:
         log.error("rpc failed (%s) -- is the server running at %s?",
                   exc.code() if hasattr(exc, "code") else exc,

@@ -14,6 +14,23 @@ the reference's documented-but-missing behaviors implemented:
 - per-frame errors produce an error-status response and keep the stream
   alive instead of tearing it down;
 - metrics writes are buffered and thread-safe (serving/metrics.py).
+
+Resilience (resilience/ package):
+
+- registry resolution runs under a per-service circuit breaker: a sustained
+  registry outage opens the breaker, the hot-reload poller fast-fails
+  without touching the network, and the server keeps serving its current
+  engine (state transitions are logged once each -- this replaces the old
+  module-global rate-limited warning, whose shared timestamp let one
+  server's warning silence another's for 60 s);
+- each frame honors the client's gRPC deadline and cancellation BEFORE
+  paying decode + device time, and dispatcher submits carry that deadline;
+- an overloaded batch dispatcher sheds load with RESOURCE_EXHAUSTED;
+- the standard grpc.health.v1 health service (serving/health.py) reports
+  readiness, flipping to SERVING only after model warm-up and back to
+  NOT_SERVING when a drain begins;
+- close() drains in-flight streams (bounded by ServerConfig.drain_grace_s)
+  before tearing the engines down.
 """
 
 from __future__ import annotations
@@ -30,6 +47,14 @@ import numpy as np
 from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.io.frames import load_calibration
 from robotic_discovery_platform_tpu.ops import pipeline
+from robotic_discovery_platform_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    inject,
+)
+from robotic_discovery_platform_tpu.serving import health as health_lib
+from robotic_discovery_platform_tpu.serving.batching import OverloadedError
 from robotic_discovery_platform_tpu.serving.metrics import MetricsWriter
 from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
 from robotic_discovery_platform_tpu.utils.config import (
@@ -42,15 +67,15 @@ from robotic_discovery_platform_tpu.utils.profiling import StageTimer
 log = get_logger(__name__)
 
 
-_resolve_warn_ts = [0.0]  # rate limit for the unreachable-registry warning
-
-
-def resolve_serving_version(cfg: ServerConfig, store=None) -> int | None:
+def resolve_serving_version(cfg: ServerConfig, store=None, *,
+                            raise_on_error: bool = False) -> int | None:
     """The registry version serving should run: the ``staging`` alias when
     set, else the latest version; None when the registry is empty or
-    unreachable (callers decide whether that is fatal). Failures are
-    logged (rate-limited to one per minute) so a silently-broken registry
-    doesn't make the hot-reload poller inert with zero diagnostics.
+    unreachable (callers decide whether that is fatal). With
+    ``raise_on_error`` the failure propagates instead -- that is how the
+    service's circuit breaker observes outcomes (serving/server.py used to
+    rate-limit this warning through a module-global timestamp shared by
+    every server instance; the per-service breaker replaced it).
 
     Uses a store SCOPED to ``cfg.tracking_uri`` (tracking.store_for):
     the reload poller calls this from a background thread, and mutating
@@ -59,6 +84,7 @@ def resolve_serving_version(cfg: ServerConfig, store=None) -> int | None:
     pass a cached ``store`` -- rebuilding an MLflow-backed store every
     tick would churn clients and scratch dirs."""
     try:
+        inject("serving.resolve")
         store = store if store is not None else tracking.store_for(
             cfg.tracking_uri
         )
@@ -67,13 +93,12 @@ def resolve_serving_version(cfg: ServerConfig, store=None) -> int | None:
             return int(version)
         return int(store.latest_version(cfg.model_name)["version"])
     except Exception as exc:
-        now = time.monotonic()
-        if now - _resolve_warn_ts[0] > 60.0:
-            _resolve_warn_ts[0] = now
-            log.warning(
-                "registry %s unreachable/empty (%s: %s); serving keeps its "
-                "current model", cfg.tracking_uri, type(exc).__name__, exc,
-            )
+        if raise_on_error:
+            raise
+        log.warning(
+            "registry %s unreachable/empty (%s: %s); serving keeps its "
+            "current model", cfg.tracking_uri, type(exc).__name__, exc,
+        )
         return None
 
 
@@ -144,6 +169,23 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # pending grace-delayed (timer, old_dispatcher) teardowns; close()
         # cancels the timers and stops the dispatchers immediately
         self._grace_stops: list[tuple[threading.Timer, Any]] = []
+        # Per-service registry breaker: a sustained registry outage opens
+        # it and the reload poller fast-fails without touching the network
+        # (and without per-tick log spam -- the breaker logs transitions).
+        self.registry_breaker = CircuitBreaker(
+            failure_threshold=cfg.registry_breaker_failures,
+            reset_timeout_s=cfg.registry_breaker_reset_s,
+            name=f"registry:{cfg.tracking_uri}",
+        )
+        # grpc.health.v1 state: NOT_SERVING until warm-up completes
+        # (build_server / warmup flip it), NOT_SERVING again once a drain
+        # begins.
+        self.health = health_lib.HealthServicer()
+        self.health.set(vision_grpc.SERVICE_NAME, health_lib.NOT_SERVING)
+        # in-flight stream accounting for graceful drain
+        self._streams_cond = threading.Condition()
+        self._active_streams = 0
+        self._draining = False
         self.metrics = metrics or MetricsWriter(
             cfg.metrics_csv, cfg.metrics_flush_every
         )
@@ -193,6 +235,9 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 ),
                 window_ms=cfg.batch_window_ms,
                 max_batch=cfg.max_batch,
+                max_backlog=cfg.max_backlog,
+                submit_timeout_s=cfg.submit_deadline_s,
+                watchdog_interval_s=cfg.watchdog_interval_s,
             )
         return Engine(analyze, variables, dispatcher, version)
 
@@ -230,9 +275,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         return color, depth
 
     def _analyze_frame(self, color_bgr: np.ndarray, depth: np.ndarray,
-                       timer: StageTimer | None = None):
+                       timer: StageTimer | None = None,
+                       timeout_s: float | None = None):
         import cv2
 
+        inject("serving.analyze")
         timer = timer or StageTimer()
         h, w = color_bgr.shape[:2]
         k = self.intrinsics if self.intrinsics is not None else _default_intrinsics(w, h)
@@ -242,9 +289,13 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         eng = self._engine
         with timer.stage("device"):
             if eng.dispatcher is not None:
-                # coalesce with co-arriving frames from other streams
+                # coalesce with co-arriving frames from other streams; the
+                # submit carries the caller's remaining deadline so a
+                # cancelled/expired client frees this thread instead of
+                # parking it on an unbounded wait
                 out = eng.dispatcher.submit(
-                    rgb, depth, np.asarray(k, np.float32), self.depth_scale
+                    rgb, depth, np.asarray(k, np.float32), self.depth_scale,
+                    timeout_s=timeout_s,
                 )
             else:
                 out = eng.analyze(
@@ -269,42 +320,120 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             raise ValueError("mask encode failed")
         return mean_k, max_k, spline, mask_png.tobytes(), coverage, valid
 
+    def _enter_stream(self) -> bool:
+        with self._streams_cond:
+            if self._draining or self._closed:
+                return False
+            self._active_streams += 1
+            return True
+
+    def _exit_stream(self) -> None:
+        with self._streams_cond:
+            self._active_streams -= 1
+            self._streams_cond.notify_all()
+
+    @property
+    def active_streams(self) -> int:
+        with self._streams_cond:
+            return self._active_streams
+
     def AnalyzeActuatorPerformance(self, request_iterator, context):
-        # per-stream stage breakdown (decode / device / encode); summarized
-        # at stream end so proc_time_ms has an explanation in the logs
-        timer = StageTimer()
-        for request in request_iterator:
-            t0 = time.perf_counter()
-            try:
-                with timer.stage("decode"):
-                    color, depth = self._decode(request)
-                mean_k, max_k, spline, mask_png, coverage, valid = (
-                    self._analyze_frame(color, depth, timer)
-                )
-                response = vision_pb2.AnalysisResponse(
-                    mean_curvature=mean_k,
-                    max_curvature=max_k,
-                    spline_points=[
-                        vision_pb2.Point3D(x=float(p[0]), y=float(p[1]), z=float(p[2]))
-                        for p in spline
-                    ],
-                    status="OK" if valid else "DEGRADED: insufficient geometry",
-                    mask=mask_png,
-                    mask_coverage=coverage,
-                )
-                self.metrics.append(mean_k, max_k, coverage)
-            except Exception as exc:  # keep the stream alive per frame
-                log.exception("analysis error")
-                response = vision_pb2.AnalysisResponse(
-                    status=f"ERROR: {type(exc).__name__}: {exc}"
-                )
-            response.proc_time_ms = (time.perf_counter() - t0) * 1e3
-            yield response
-        self.metrics.flush()
-        if timer.totals:
-            log.info("stream stage breakdown: %s", timer.summary())
+        if not self._enter_stream():
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "server is draining; retry against another "
+                          "replica")
+        try:
+            # per-stream stage breakdown (decode / device / encode);
+            # summarized at stream end so proc_time_ms has an explanation
+            # in the logs
+            timer = StageTimer()
+            for request in request_iterator:
+                # honor cancellation and the client's deadline BEFORE
+                # paying decode + device time for a frame nobody is
+                # waiting on (the old path dispatched regardless, holding
+                # a handler thread and a device slot for a gone client)
+                if not context.is_active():
+                    log.info("stream cancelled/closed by client; "
+                             "freeing handler")
+                    break
+                remaining = context.time_remaining()
+                if remaining is not None and remaining <= 0:
+                    break
+                t0 = time.perf_counter()
+                try:
+                    with timer.stage("decode"):
+                        color, depth = self._decode(request)
+                    mean_k, max_k, spline, mask_png, coverage, valid = (
+                        self._analyze_frame(color, depth, timer,
+                                            timeout_s=remaining)
+                    )
+                    response = vision_pb2.AnalysisResponse(
+                        mean_curvature=mean_k,
+                        max_curvature=max_k,
+                        spline_points=[
+                            vision_pb2.Point3D(x=float(p[0]), y=float(p[1]), z=float(p[2]))
+                            for p in spline
+                        ],
+                        status="OK" if valid else "DEGRADED: insufficient geometry",
+                        mask=mask_png,
+                        mask_coverage=coverage,
+                    )
+                    self.metrics.append(mean_k, max_k, coverage)
+                except OverloadedError as exc:
+                    # load shedding is a STREAM-level, retryable condition:
+                    # surface the standard backpressure status instead of a
+                    # per-frame error payload the client cannot distinguish
+                    # from a bad frame
+                    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                  str(exc))
+                except DeadlineExceeded as exc:
+                    # per-submit deadline (client deadline or
+                    # cfg.submit_deadline_s) ran out while the frame was
+                    # queued/processing: report per-frame and keep the
+                    # stream alive -- the handler thread is free again
+                    log.warning("frame missed its deadline: %s", exc)
+                    response = vision_pb2.AnalysisResponse(
+                        status=f"ERROR: DeadlineExceeded: {exc}"
+                    )
+                except Exception as exc:  # keep the stream alive per frame
+                    log.exception("analysis error")
+                    response = vision_pb2.AnalysisResponse(
+                        status=f"ERROR: {type(exc).__name__}: {exc}"
+                    )
+                response.proc_time_ms = (time.perf_counter() - t0) * 1e3
+                yield response
+            self.metrics.flush()
+            if timer.totals:
+                log.info("stream stage breakdown: %s", timer.summary())
+        finally:
+            self._exit_stream()
 
     # -- hot-reload ---------------------------------------------------------
+
+    def _resolve_version(self) -> int | None:
+        """Registry resolution under the per-service circuit breaker.
+
+        Closed: failures log a warning and count toward the threshold.
+        Open: the poll is skipped entirely -- no network touch, no log
+        line, serving keeps its current engine; the breaker logs the
+        open/half-open/closed transitions exactly once each."""
+        try:
+            return self.registry_breaker.call(
+                lambda: resolve_serving_version(
+                    self.cfg, self._registry_store, raise_on_error=True
+                )
+            )
+        except CircuitOpenError:
+            return None
+        except Exception as exc:
+            log.warning(
+                "registry %s unreachable/empty (%s: %s); serving keeps "
+                "its current model (breaker: %d/%d failures)",
+                self.cfg.tracking_uri, type(exc).__name__, exc,
+                self.registry_breaker.failure_count,
+                self.registry_breaker.failure_threshold,
+            )
+            return None
 
     def start_reloader(self) -> None:
         """Poll the registry every ``cfg.reload_poll_s`` seconds; when the
@@ -344,7 +473,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             current_version = self._engine.version
         engine = None
         try:
-            version = resolve_serving_version(self.cfg, self._registry_store)
+            version = self._resolve_version()
             if version is None or version == current_version:
                 return False
             # scoped store: this runs on the poller thread (see
@@ -478,10 +607,46 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self._analyze_frame(color, depth)
         with self._reload_lock:
             self._warm_engine(self._engine)
+        # readiness flips ONLY here: a probe sees SERVING once the first
+        # real frame path has compiled and run, never before
+        self.mark_ready()
         log.info("warmed up %dx%d analyzer on %s", width, height,
                  jax.default_backend())
 
+    def mark_ready(self) -> None:
+        self.health.set_all(health_lib.SERVING)
+
+    def drain(self, timeout_s: float | None = None) -> bool:
+        """Begin graceful shutdown: flip readiness to NOT_SERVING, refuse
+        new streams (UNAVAILABLE, so clients fail over), and wait up to
+        ``timeout_s`` (default ``cfg.drain_grace_s``) for in-flight streams
+        to finish. Returns True when the server drained fully. Idempotent;
+        close() calls it first."""
+        timeout_s = self.cfg.drain_grace_s if timeout_s is None else timeout_s
+        with self._streams_cond:
+            already = self._draining
+            self._draining = True
+        if not already:
+            self.health.set_all(health_lib.NOT_SERVING)
+            log.info("draining: readiness down, waiting for %d in-flight "
+                     "stream(s)", self.active_streams)
+        deadline = time.monotonic() + timeout_s
+        with self._streams_cond:
+            while self._active_streams > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    log.warning(
+                        "drain grace (%.1fs) expired with %d stream(s) "
+                        "still in flight", timeout_s, self._active_streams,
+                    )
+                    return False
+                self._streams_cond.wait(remaining)
+        return True
+
     def close(self) -> None:
+        # readiness down + bounded wait for in-flight streams BEFORE
+        # tearing down the engines they are using
+        self.drain()
         # flag first: an in-flight reload re-checks it before swapping, so
         # a generation built after this point never goes live
         self._closed = True
@@ -541,10 +706,17 @@ def build_server(
         version=version,
     )
     if warmup_shape is not None:
-        servicer.warmup(*warmup_shape)
+        servicer.warmup(*warmup_shape)  # flips readiness at the end
+    else:
+        # no warm-up requested: the model is loaded and the engine built,
+        # which is as warm as this deployment gets -- readiness up now
+        servicer.mark_ready()
     servicer.start_reloader()
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=cfg.max_workers))
     vision_grpc.add_VisionAnalysisServiceServicer_to_server(servicer, server)
+    # standard grpc.health.v1 surface: `grpc_health_probe -addr=...` and
+    # Kubernetes native gRPC probes work against this port unmodified
+    health_lib.add_HealthServicer_to_server(servicer.health, server)
     server.add_insecure_port(cfg.address)
     return server, servicer
 
@@ -555,7 +727,13 @@ def serve(cfg: ServerConfig = ServerConfig(), warmup_shape=(640, 480)) -> None:
     log.info("vision analysis server listening on %s", cfg.address)
     try:
         server.wait_for_termination()
+    except KeyboardInterrupt:
+        log.info("interrupt: beginning graceful shutdown")
     finally:
+        # readiness down first so load balancers stop routing here, then a
+        # bounded drain of in-flight streams, then the hard stop
+        servicer.drain()
+        server.stop(grace=cfg.drain_grace_s).wait()
         servicer.close()
 
 

@@ -21,6 +21,7 @@ socket.
 
 from __future__ import annotations
 
+import os
 import posixpath
 import tempfile
 import time
@@ -28,8 +29,34 @@ from pathlib import Path
 
 import requests
 
+from robotic_discovery_platform_tpu.resilience import (
+    Deadline,
+    RetryPolicy,
+    inject,
+)
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
 _API = "/api/2.0/mlflow"
 _ARTIFACTS = "/api/2.0/mlflow-artifacts/artifacts"
+
+# Fault-injection site covering every HTTP round-trip this store makes
+# (tracking API calls and artifact proxy transfers alike); see
+# resilience/faults.py for the RDP_FAULTS spec grammar.
+FAULT_SITE = "tracking.rest.request"
+
+
+def _default_retry() -> RetryPolicy:
+    """Transient HTTP failures (ConnectionError/timeout, 429, 5xx) retry
+    with jittered exponential backoff. Env-tunable so chaos tests (and
+    latency-sensitive deployments) reshape the schedule without code:
+    RDP_HTTP_RETRIES (attempts), RDP_HTTP_BACKOFF_S (base delay)."""
+    return RetryPolicy(
+        max_attempts=int(os.environ.get("RDP_HTTP_RETRIES", "3")),
+        base_delay_s=float(os.environ.get("RDP_HTTP_BACKOFF_S", "0.2")),
+        max_delay_s=5.0,
+    )
 
 
 class MlflowRestError(RuntimeError):
@@ -44,9 +71,21 @@ class MlflowRestError(RuntimeError):
 class RestMlflowStore:
     """FileStore-protocol adapter speaking MLflow's REST API directly."""
 
-    def __init__(self, uri: str, timeout_s: float = 30.0):
+    def __init__(self, uri: str, timeout_s: float = 30.0,
+                 retry: RetryPolicy | None = None,
+                 deadline_s: float | None = None):
         self.uri = uri.rstrip("/")
         self.timeout_s = timeout_s
+        # ``timeout_s`` bounds ONE socket-level request; ``deadline_s`` is
+        # the overall budget for one logical call *including* its retries,
+        # so a flaky server cannot stretch a single resolve to
+        # retries * timeout.
+        self.deadline_s = (
+            deadline_s if deadline_s is not None
+            else float(os.environ.get("RDP_HTTP_DEADLINE_S",
+                                      str(2.0 * timeout_s)))
+        )
+        self._retry = retry if retry is not None else _default_retry()
         self._http = requests.Session()
         self._make_scratch()
 
@@ -73,22 +112,41 @@ class RestMlflowStore:
 
     # -- transport ----------------------------------------------------------
 
-    def _call(self, method: str, endpoint: str, *, params=None, body=None):
-        resp = self._http.request(
-            method, f"{self.uri}{_API}/{endpoint}", params=params,
-            json=body, timeout=self.timeout_s,
-        )
-        if resp.status_code >= 400:
-            try:
-                err = resp.json()
-            except ValueError:
-                err = {}
-            raise MlflowRestError(
-                resp.status_code,
-                err.get("error_code", "INTERNAL_ERROR"),
-                err.get("message", resp.text[:200]),
+    def _retrying(self, what: str, fn):
+        """One logical REST operation: every attempt shares a Deadline
+        budget, transient failures (connection errors, timeouts, 429, 5xx
+        -- resilience.default_retryable) back off and retry, and the
+        underlying error surfaces unchanged once the policy gives up."""
+        deadline = Deadline.after(self.deadline_s, self._retry.clock)
+
+        def on_retry(attempt: int, exc: BaseException, delay: float):
+            log.warning(
+                "transient failure on %s (%s: %s); retry %d in %.2fs",
+                what, type(exc).__name__, exc, attempt, delay,
             )
-        return resp.json() if resp.content else {}
+
+        return self._retry.call(fn, deadline=deadline, on_retry=on_retry)
+
+    def _call(self, method: str, endpoint: str, *, params=None, body=None):
+        def attempt():
+            inject(FAULT_SITE)
+            resp = self._http.request(
+                method, f"{self.uri}{_API}/{endpoint}", params=params,
+                json=body, timeout=self.timeout_s,
+            )
+            if resp.status_code >= 400:
+                try:
+                    err = resp.json()
+                except ValueError:
+                    err = {}
+                raise MlflowRestError(
+                    resp.status_code,
+                    err.get("error_code", "INTERNAL_ERROR"),
+                    err.get("message", resp.text[:200]),
+                )
+            return resp.json() if resp.content else {}
+
+        return self._retrying(f"{method} {endpoint}", attempt)
 
     # -- experiments / runs -------------------------------------------------
 
@@ -199,22 +257,39 @@ class RestMlflowStore:
             rel = posixpath.join(local_dir.name,
                                  f.relative_to(local_dir).as_posix())
             path = self._artifact_http_path(root, rel)
-            resp = self._http.put(
-                f"{self.uri}{_ARTIFACTS}/{path}", data=f.read_bytes(),
-                timeout=self.timeout_s,
-            )
+            data = f.read_bytes()
+
+            def put_attempt(path=path, data=data):
+                inject(FAULT_SITE)
+                resp = self._http.put(
+                    f"{self.uri}{_ARTIFACTS}/{path}", data=data,
+                    timeout=self.timeout_s,
+                )
+                if resp.status_code >= 400:
+                    raise MlflowRestError(resp.status_code, "INTERNAL_ERROR",
+                                          resp.text[:200])
+
+            # artifact PUTs are idempotent (same bytes, same path), so a
+            # lost-response retry is safe
+            self._retrying(f"PUT artifact {path}", put_attempt)
+
+    def _artifact_get(self, what: str, url: str, params=None):
+        def attempt():
+            inject(FAULT_SITE)
+            resp = self._http.get(url, params=params,
+                                  timeout=self.timeout_s)
             if resp.status_code >= 400:
                 raise MlflowRestError(resp.status_code, "INTERNAL_ERROR",
                                       resp.text[:200])
+            return resp
+
+        return self._retrying(what, attempt)
 
     def _download_tree(self, http_root: str, dest: Path) -> None:
-        listing = self._http.get(
-            f"{self.uri}{_ARTIFACTS}", params={"path": http_root},
-            timeout=self.timeout_s,
+        listing = self._artifact_get(
+            f"LIST artifacts {http_root}", f"{self.uri}{_ARTIFACTS}",
+            params={"path": http_root},
         )
-        if listing.status_code >= 400:
-            raise MlflowRestError(listing.status_code, "INTERNAL_ERROR",
-                                  listing.text[:200])
         for entry in listing.json().get("files", []):
             # per the proxy contract, entry["path"] is relative to the
             # queried directory
@@ -222,11 +297,8 @@ class RestMlflowStore:
             if entry.get("is_dir"):
                 self._download_tree(sub, dest / entry["path"])
                 continue
-            resp = self._http.get(f"{self.uri}{_ARTIFACTS}/{sub}",
-                                  timeout=self.timeout_s)
-            if resp.status_code >= 400:
-                raise MlflowRestError(resp.status_code, "INTERNAL_ERROR",
-                                      resp.text[:200])
+            resp = self._artifact_get(f"GET artifact {sub}",
+                                      f"{self.uri}{_ARTIFACTS}/{sub}")
             out = dest / entry["path"]
             out.parent.mkdir(parents=True, exist_ok=True)
             out.write_bytes(resp.content)

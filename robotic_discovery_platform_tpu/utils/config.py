@@ -206,6 +206,29 @@ class ServerConfig:
     # After a hot-reload swap, how long the OLD engine's batch dispatcher
     # stays alive for in-flight frames before its drain-safe teardown.
     reload_grace_s: float = 10.0
+    # -- resilience (robotic_discovery_platform_tpu/resilience/) -----------
+    # Registry circuit breaker: after this many consecutive resolve
+    # failures the breaker opens and the hot-reload poller fast-fails
+    # (serving keeps its current model) until one half-open probe succeeds.
+    registry_breaker_failures: int = 3
+    # How long the open breaker fast-fails before admitting a probe.
+    registry_breaker_reset_s: float = 60.0
+    # Per-frame budget a handler thread may block on the batch dispatcher
+    # (replaces the old unbounded done.wait()); the gRPC client deadline,
+    # when tighter, wins. Generous by default: an UNWARMED engine pays its
+    # XLA compile inside the first submit (warmup()/hot-reload warming
+    # pre-compiles every bucket precisely so served frames never hit this).
+    submit_deadline_s: float = 30.0
+    # Load shedding: a submit arriving while this many frames are already
+    # queued for the collector fast-fails with RESOURCE_EXHAUSTED instead
+    # of growing an unbounded backlog.
+    max_backlog: int = 64
+    # Collector-thread watchdog poll interval (<= 0 disables): a dead
+    # collector error-completes its pending frames and is restarted.
+    watchdog_interval_s: float = 1.0
+    # Graceful shutdown: how long close() waits for in-flight streams to
+    # finish after readiness flips to NOT_SERVING.
+    drain_grace_s: float = 5.0
 
 
 @dataclass(frozen=True)
