@@ -1,0 +1,44 @@
+"""First-party observability: metrics registry, Prometheus exposition, and
+trace propagation.
+
+PR 2 made the serving stack resilient (retries, a circuit breaker, load
+shedding, a collector watchdog) but every one of those mechanisms was
+invisible in production: breaker transitions and shed frames appeared only
+in logs, and the platform's single monitoring surface was the per-frame CSV
+the drift detector consumes. This package is the third leg of the
+analysis -> resilience -> observability triad:
+
+- :mod:`registry` -- zero-dependency, thread-safe Counter / Gauge /
+  Histogram primitives with label support, a process-global default
+  registry, and a ``time_histogram`` context manager.
+- :mod:`exposition` -- the Prometheus text-format 0.0.4 renderer plus a
+  tiny stdlib ``http.server`` endpoint (``GET /metrics``), started and
+  stopped with the gRPC server lifecycle (``ServerConfig.metrics_port`` /
+  ``RDP_METRICS_PORT``; off by default).
+- :mod:`trace` -- lightweight spans with W3C-style ``traceparent`` IDs
+  propagated client -> server through gRPC metadata and stamped into every
+  log line, so one frame's journey (client submit -> batch queue -> device
+  dispatch -> response) is correlatable across processes.
+- :mod:`instruments` -- the canonical ``rdp_*`` metric families wired
+  through serving, batching, resilience, tracking, and training (the
+  resilience package stays import-clean of this one: it exposes injectable
+  observer hooks that :mod:`instruments` installs).
+"""
+
+from robotic_discovery_platform_tpu.observability.registry import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    time_histogram,
+)
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "time_histogram",
+]

@@ -1,0 +1,147 @@
+"""Prometheus text-format 0.0.4 rendering + the stdlib /metrics endpoint.
+
+``render`` serializes a :class:`~.registry.MetricsRegistry` into the
+Prometheus exposition format (the 0.0.4 text contract: ``# HELP`` /
+``# TYPE`` headers, escaped help and label values, cumulative histogram
+buckets ending at ``+Inf``). ``MetricsServer`` is a daemon-thread
+``http.server`` wrapper serving ``GET /metrics`` -- deliberately not the
+gRPC port: scrapers and humans reach it with plain curl, and a wedged gRPC
+thread pool cannot take the diagnostics surface down with it.
+
+Lifecycle: ``serving.server.build_server`` starts one when
+``ServerConfig.metrics_port`` / ``RDP_METRICS_PORT`` asks for it and
+``VisionAnalysisService.close()`` stops it, so the endpoint lives exactly
+as long as the service it describes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from robotic_discovery_platform_tpu.observability.registry import (
+    REGISTRY,
+    MetricsRegistry,
+)
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label_value(s: str) -> str:
+    return (
+        s.replace("\\", r"\\").replace("\n", r"\n").replace('"', r"\"")
+    )
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def render(registry: MetricsRegistry = REGISTRY) -> str:
+    """The registry's current state as Prometheus text format 0.0.4.
+
+    Families are name-sorted and children label-sorted, so two renders of
+    the same state are byte-identical (the golden tests rely on that)."""
+    lines: list[str] = []
+    for metric in registry.collect():
+        lines.append(f"# HELP {metric.name} {_escape_help(metric.help)}")
+        lines.append(f"# TYPE {metric.name} {metric.kind}")
+        for sample in metric.samples():
+            if sample.labels:
+                labelstr = ",".join(
+                    f'{k}="{_escape_label_value(v)}"'
+                    for k, v in sample.labels
+                )
+                lines.append(
+                    f"{metric.name}{sample.suffix}{{{labelstr}}} "
+                    f"{_fmt_value(sample.value)}"
+                )
+            else:
+                lines.append(
+                    f"{metric.name}{sample.suffix} "
+                    f"{_fmt_value(sample.value)}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """``GET /metrics`` over stdlib ``http.server``, on a daemon thread.
+
+    ``port=0`` binds an ephemeral port (tests; read it back from
+    ``self.port``). ``start()`` returns self; ``stop()`` is idempotent."""
+
+    def __init__(self, port: int, registry: MetricsRegistry = REGISTRY,
+                 host: str = "0.0.0.0"):
+        self._registry = registry
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server contract)
+                if self.path.split("?")[0] != "/metrics":
+                    self.send_error(404, "try /metrics")
+                    return
+                body = render(outer._registry).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass  # scrapes every few seconds must not spam the log
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="metrics-exposition",
+                daemon=True,
+            )
+            self._thread.start()
+            log.info("metrics exposition on :%d/metrics", self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+
+
+def resolve_metrics_port(cfg_port: int) -> int | None:
+    """The effective exposition port: ``RDP_METRICS_PORT`` overrides the
+    config value; 0 / unset means off; negative means "ephemeral port"
+    (tests and smoke scripts that cannot reserve a fixed one)."""
+    raw = os.environ.get("RDP_METRICS_PORT", "")
+    port = int(raw) if raw.strip() else cfg_port
+    if port == 0:
+        return None
+    return max(port, 0)
+
+
+def maybe_start_metrics_server(cfg_port: int,
+                               registry: MetricsRegistry = REGISTRY,
+                               ) -> MetricsServer | None:
+    """Start an exposition server when configuration asks for one."""
+    port = resolve_metrics_port(cfg_port)
+    if port is None:
+        return None
+    return MetricsServer(port, registry).start()

@@ -1,0 +1,117 @@
+"""The canonical ``rdp_*`` metric families, defined once.
+
+Every instrumented subsystem (serving, batching, tracking, training)
+imports its instruments from here, so the full metric surface is readable
+in one place and two call sites can never register conflicting schemas for
+the same family. The README "Observability" section's table mirrors this
+module.
+
+Resilience is the one subsystem that must stay import-clean of
+observability (it sits below everything, including this package's logging)
+-- it exposes injectable observer hooks instead, and importing this module
+installs them (idempotent: re-installation is a no-op assignment of the
+same functions).
+"""
+
+from __future__ import annotations
+
+from robotic_discovery_platform_tpu.observability.registry import REGISTRY
+
+# -- serving -----------------------------------------------------------------
+
+FRAMES = REGISTRY.counter(
+    "rdp_frames_total",
+    "Frames handled by the analysis server, by terminal status "
+    "(ok, degraded, error, deadline, shed).",
+    ("status",),
+)
+STAGE_LATENCY = REGISTRY.histogram(
+    "rdp_stage_latency_seconds",
+    "Per-frame serving stage latency (decode, device, encode, total).",
+    ("stage",),
+)
+INFLIGHT_STREAMS = REGISTRY.gauge(
+    "rdp_inflight_streams",
+    "gRPC analysis streams currently open.",
+)
+
+# -- batching ----------------------------------------------------------------
+
+BATCH_QUEUE_DEPTH = REGISTRY.gauge(
+    "rdp_batch_queue_depth",
+    "Frames waiting in the batch dispatcher's collector queue.",
+)
+BATCH_SIZE = REGISTRY.histogram(
+    "rdp_batch_size_frames",
+    "Frames coalesced into one batched device dispatch.",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+WATCHDOG_RESTARTS = REGISTRY.counter(
+    "rdp_batch_watchdog_restarts_total",
+    "Times the watchdog restarted a dead batch collector thread.",
+)
+
+# -- resilience --------------------------------------------------------------
+
+#: closed=0 / open=1 / half_open=2 (alert on `rdp_breaker_state == 1`).
+BREAKER_STATE = REGISTRY.gauge(
+    "rdp_breaker_state",
+    "Circuit breaker state: 0 closed, 1 open, 2 half-open.",
+    ("breaker",),
+)
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "rdp_breaker_transitions_total",
+    "Circuit breaker state transitions, by destination state.",
+    ("breaker", "to"),
+)
+RETRIES = REGISTRY.counter(
+    "rdp_retry_attempts_total",
+    "Retry attempts (attempt N+1 scheduled after a transient failure), "
+    "by call site.",
+    ("site",),
+)
+
+# -- tracking ----------------------------------------------------------------
+
+HTTP_REQUESTS = REGISTRY.histogram(
+    "rdp_http_request_seconds",
+    "Tracking/registry HTTP round-trip latency, by outcome (one sample "
+    "per attempt, retries included).",
+    ("outcome",),
+)
+
+# -- training ----------------------------------------------------------------
+
+TRAIN_STEP = REGISTRY.histogram(
+    "rdp_train_step_seconds",
+    "Mean optimizer-step wall time, observed once per epoch (whole-epoch "
+    "scan dispatches have no per-step boundary to time).",
+)
+TRAIN_RATE = REGISTRY.gauge(
+    "rdp_train_examples_per_second",
+    "Training throughput over the last epoch's train phase.",
+)
+
+_BREAKER_STATE_VALUES = {"closed": 0, "open": 1, "half_open": 2}
+
+
+def _on_breaker_transition(name: str, old: str | None, new: str) -> None:
+    BREAKER_STATE.labels(breaker=name).set(
+        _BREAKER_STATE_VALUES.get(new, -1)
+    )
+    if old is not None:  # creation announces state without a transition
+        BREAKER_TRANSITIONS.labels(breaker=name, to=new).inc()
+
+
+def _on_retry(site: str | None, attempt: int) -> None:
+    RETRIES.labels(site=site or "unnamed").inc()
+
+
+def install_resilience_hooks() -> None:
+    from robotic_discovery_platform_tpu.resilience import breaker, policy
+
+    breaker.set_observer(_on_breaker_transition)
+    policy.set_retry_observer(_on_retry)
+
+
+install_resilience_hooks()

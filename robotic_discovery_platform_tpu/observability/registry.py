@@ -1,0 +1,356 @@
+"""Zero-dependency, thread-safe metrics primitives.
+
+Counter / Gauge / Histogram with label support, modeled on the Prometheus
+client data model but stdlib-only (the image carries no prometheus_client
+and nothing may be installed). One lock per metric family guards its child
+map and every sample mutation; children cache their value cell so the hot
+path (``child.inc()`` / ``child.observe()``) is a lock + a float add.
+
+Naming follows Prometheus conventions: family names match
+``[a-zA-Z_:][a-zA-Z0-9_:]*``, label names match ``[a-zA-Z_][a-zA-Z0-9_]*``
+and may not start with ``__`` (reserved). Histograms use fixed exponential
+latency buckets by default (1 ms doubling to ~16 s) -- latency is this
+platform's dominant measured quantity and exponential buckets keep p99
+resolution roughly constant across four decades.
+
+``MetricsRegistry`` is get-or-create: asking twice for the same family
+returns the same object, and asking with a *different* type or label set
+raises -- two call sites silently disagreeing about a family's schema is
+exactly the bug a registry exists to prevent. ``REGISTRY`` is the
+process-global default every subsystem shares; tests build private
+registries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+import time
+from typing import Callable, Iterator, NamedTuple, Sequence
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: 1 ms doubling to ~16.4 s: fixed exponential latency buckets shared by
+#: every duration histogram unless a family overrides them.
+LATENCY_BUCKETS: tuple[float, ...] = tuple(0.001 * 2**k for k in range(15))
+
+
+class Sample(NamedTuple):
+    """One exposition line: ``name{labels} value`` (suffix appended to the
+    family name -- "" for plain samples, ``_bucket``/``_sum``/``_count``
+    for histogram series)."""
+
+    suffix: str
+    labels: tuple[tuple[str, str], ...]
+    value: float
+
+
+def _validate_labelnames(labelnames: Sequence[str]) -> tuple[str, ...]:
+    names = tuple(labelnames)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate label names: {names}")
+    for n in names:
+        if not _LABEL_RE.match(n) or n.startswith("__"):
+            raise ValueError(f"invalid label name {n!r}")
+    return names
+
+
+class _Metric:
+    """Shared family machinery: name/help/label validation, the child map,
+    and the per-family lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = ()):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = _validate_labelnames(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict[tuple[str, ...], object] = {}
+        if not self.labelnames:
+            # the unlabeled singleton child, so `metric.inc()` works
+            self._children[()] = self._make_child(())
+
+    def _make_child(self, values: tuple[str, ...]):
+        raise NotImplementedError
+
+    def labels(self, **labels: str):
+        """The child for one label-value combination (created on first
+        use). Exactly the declared label names must be given."""
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        values = tuple(str(labels[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self._make_child(values)
+            return child
+
+    def _require_unlabeled(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} has labels {self.labelnames}; "
+                "use .labels(...) first"
+            )
+        return self._children[()]
+
+    def _sorted_children(self):
+        with self._lock:
+            return sorted(self._children.items())
+
+    def samples(self) -> Iterator[Sample]:
+        for values, child in self._sorted_children():
+            yield from child._samples(tuple(zip(self.labelnames, values)))
+
+
+class _CounterChild:
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _samples(self, labels):
+        yield Sample("", labels, self.value)
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (events, frames, errors)."""
+
+    kind = "counter"
+
+    def _make_child(self, values):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._require_unlabeled().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._require_unlabeled().value
+
+
+class _GaugeChild:
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _samples(self, labels):
+        yield Sample("", labels, self.value)
+
+
+class Gauge(_Metric):
+    """Point-in-time value that can go both ways (queue depth, in-flight
+    streams, breaker state)."""
+
+    kind = "gauge"
+
+    def _make_child(self, values):
+        return _GaugeChild(self._lock)
+
+    def set(self, value: float) -> None:
+        self._require_unlabeled().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._require_unlabeled().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._require_unlabeled().dec(amount)
+
+    @property
+    def value(self) -> float:
+        return self._require_unlabeled().value
+
+
+class _HistogramChild:
+    def __init__(self, lock: threading.Lock, buckets: tuple[float, ...]):
+        self._lock = lock
+        self._buckets = buckets
+        self._counts = [0] * (len(buckets) + 1)  # last slot: > max bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, bound in enumerate(self._buckets):
+                if value <= bound:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @contextlib.contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def _samples(self, labels):
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cumulative = 0
+        for bound, n in zip(self._buckets, counts):
+            cumulative += n
+            yield Sample("_bucket", labels + (("le", _fmt_bound(bound)),),
+                         float(cumulative))
+        yield Sample("_bucket", labels + (("le", "+Inf"),), float(total))
+        yield Sample("_sum", labels, s)
+        yield Sample("_count", labels, float(total))
+
+
+def _fmt_bound(bound: float) -> str:
+    # integral bounds render without a trailing .0, matching the upstream
+    # client's exposition (le="1" not le="1.0")
+    if bound == int(bound):
+        return str(int(bound))
+    return repr(bound)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket distribution (Prometheus histogram semantics:
+    ``_bucket{le=...}`` series are cumulative and end at ``+Inf``, with
+    ``_sum``/``_count`` companions)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] | None = None):
+        bs = tuple(buckets) if buckets is not None else LATENCY_BUCKETS
+        if not bs:
+            raise ValueError("histogram needs at least one bucket")
+        if list(bs) != sorted(bs):
+            raise ValueError(f"buckets must be sorted ascending: {bs}")
+        if "le" in labelnames:
+            raise ValueError("'le' is reserved for histogram buckets")
+        self.buckets = bs
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self, values):
+        return _HistogramChild(self._lock, self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._require_unlabeled().observe(value)
+
+    def time(self):
+        return self._require_unlabeled().time()
+
+    @property
+    def count(self) -> int:
+        return self._require_unlabeled().count
+
+    @property
+    def sum(self) -> float:
+        return self._require_unlabeled().sum
+
+
+@contextlib.contextmanager
+def time_histogram(hist):
+    """Time a block into a histogram (family or labeled child)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        hist.observe(time.perf_counter() - t0)
+
+
+class MetricsRegistry:
+    """Thread-safe name -> metric map with get-or-create semantics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], factory: Callable):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind} with labels {existing.labelnames}"
+                    )
+                return existing
+            metric = self._metrics[name] = factory()
+            return metric
+
+    def counter(self, name: str, help: str,
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(
+            Counter, name, help, labelnames,
+            lambda: Counter(name, help, labelnames),
+        )
+
+    def gauge(self, name: str, help: str,
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(
+            Gauge, name, help, labelnames,
+            lambda: Gauge(name, help, labelnames),
+        )
+
+    def histogram(self, name: str, help: str,
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] | None = None) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labelnames,
+            lambda: Histogram(name, help, labelnames, buckets),
+        )
+
+    def collect(self) -> list[_Metric]:
+        """Every registered family, name-sorted (deterministic exposition)."""
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+
+#: The process-global default registry every subsystem shares.
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,170 @@
+"""Lightweight spans with W3C-style ``traceparent`` propagation.
+
+One gRPC analysis stream is one trace: the client mints a 16-byte trace ID,
+sends it as ``traceparent`` call metadata (the W3C Trace Context header
+format, ``00-<trace_id>-<span_id>-<flags>``), and the server adopts it for
+the stream handler's lifetime. Every span within the stream (per-frame
+work, batched dispatch) shares the trace ID with a fresh span ID, and a
+``logging`` record factory stamps the current trace ID onto **every log
+record in the process**, so one grep over client + server logs follows a
+single frame's journey end to end.
+
+Context lives in a ``contextvars.ContextVar``: correct across the gRPC
+thread pool's handler threads without any thread-local bookkeeping.
+Threads spawned mid-span (the batch collector) do NOT inherit it --
+cross-thread hops carry the ``SpanContext`` object explicitly (see
+``serving/batching._Pending.trace``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Iterable
+
+TRACEPARENT = "traceparent"
+
+_TP_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+_current: contextvars.ContextVar["SpanContext | None"] = (
+    contextvars.ContextVar("rdp_trace_context", default=None)
+)
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """The propagated identity of one span: W3C trace-id (32 hex) +
+    span-id (16 hex)."""
+
+    trace_id: str
+    span_id: str
+    flags: str = "01"  # sampled
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags}"
+
+
+@dataclass
+class Span:
+    """One timed operation; ``duration_s`` is set when the span closes."""
+
+    name: str
+    context: SpanContext
+    started_at: float = field(default_factory=time.perf_counter)
+    duration_s: float | None = None
+
+    @property
+    def trace_id(self) -> str:
+        return self.context.trace_id
+
+
+def _hex_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def new_context(parent: SpanContext | None = None) -> SpanContext:
+    """A fresh span context: child of ``parent`` (same trace ID) when
+    given, a brand-new trace otherwise."""
+    trace_id = parent.trace_id if parent is not None else _hex_id(16)
+    return SpanContext(trace_id=trace_id, span_id=_hex_id(8))
+
+
+def current() -> SpanContext | None:
+    return _current.get()
+
+
+def current_trace_id() -> str | None:
+    ctx = _current.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+@contextlib.contextmanager
+def span(name: str, parent: SpanContext | None = None):
+    """Run a block inside a span. Parent resolution: explicit ``parent``
+    wins (remote contexts from gRPC metadata), else the calling context's
+    current span, else a new trace is minted."""
+    ctx = new_context(parent if parent is not None else _current.get())
+    sp = Span(name=name, context=ctx)
+    token = _current.set(ctx)
+    try:
+        yield sp
+    finally:
+        _current.reset(token)
+        sp.duration_s = time.perf_counter() - sp.started_at
+
+
+@contextlib.contextmanager
+def use(ctx: SpanContext | None):
+    """Enter an existing context verbatim (cross-thread handoff: the
+    receiving thread re-enters the context the submitting thread carried
+    over). ``None`` is a no-op so call sites need no branching."""
+    if ctx is None:
+        yield None
+        return
+    token = _current.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+
+
+def parse_traceparent(value: str) -> SpanContext | None:
+    """A ``SpanContext`` from a W3C traceparent header; None when the
+    value is malformed or carries the all-zero (invalid) IDs -- a bad
+    header must degrade to "new trace", never to an error."""
+    m = _TP_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff" or set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    return SpanContext(trace_id=trace_id, span_id=span_id, flags=flags)
+
+
+def to_metadata(ctx: SpanContext) -> tuple[tuple[str, str], ...]:
+    """gRPC call metadata carrying this context."""
+    return ((TRACEPARENT, ctx.traceparent()),)
+
+
+def from_metadata(
+    metadata: Iterable[tuple[str, str]] | None,
+) -> SpanContext | None:
+    """The remote context from gRPC invocation metadata, if any."""
+    if metadata is None:
+        return None
+    for key, value in metadata:
+        if key.lower() == TRACEPARENT:
+            return parse_traceparent(value)
+    return None
+
+
+# -- log correlation ---------------------------------------------------------
+
+_factory_installed = False
+
+
+def install_log_correlation() -> None:
+    """Stamp ``record.trace_id`` onto every log record in the process
+    (the current trace ID, or "-" outside any span). A record *factory*
+    rather than a handler filter so the attribute exists no matter which
+    handler -- ours, pytest's caplog, a user's -- formats the record.
+    Idempotent."""
+    global _factory_installed
+    if _factory_installed:
+        return
+    _factory_installed = True
+    inner = logging.getLogRecordFactory()
+
+    def factory(*args, **kwargs):
+        record = inner(*args, **kwargs)
+        record.trace_id = current_trace_id() or "-"
+        return record
+
+    logging.setLogRecordFactory(factory)

@@ -33,6 +33,20 @@ from jax.experimental import pallas as pl
 from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 
 
+def _element_block_spec(shape, index_map) -> pl.BlockSpec:
+    """A BlockSpec whose index_map returns ELEMENT offsets, across the two
+    Pallas APIs: newer jax spells it per-dimension (``pl.Element(d)``),
+    jax <= 0.4.x spells it ``indexing_mode=pl.Unblocked()`` for the whole
+    spec. The halo-slab input of the 3x3 kernel needs element indexing in
+    either spelling (overlapping row tiles cannot be expressed as block
+    indices)."""
+    if hasattr(pl, "Element"):
+        return pl.BlockSpec(
+            tuple(pl.Element(d) for d in shape), index_map
+        )
+    return pl.BlockSpec(shape, index_map, indexing_mode=pl.Unblocked())
+
+
 def use_pallas() -> bool:
     """Default policy: compiled Pallas on TPU, XLA fallback elsewhere.
 
@@ -216,12 +230,8 @@ def conv3x3_bn_relu(
         kern,
         grid=(b * tiles, cout // tile_co),
         in_specs=[
-            pl.BlockSpec(
-                (
-                    pl.Element(tile_h + 2),
-                    pl.Element(width + 2),
-                    pl.Element(cin),
-                ),
+            _element_block_spec(
+                (tile_h + 2, width + 2, cin),
                 lambda t, co: (
                     (t // tiles) * (h + 2) + (t % tiles) * tile_h, 0, 0
                 ),

@@ -27,6 +27,29 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 
+# Injectable transition observer: (breaker_name, old_state | None, new_state).
+# observability.instruments installs one that drives the rdp_breaker_state
+# gauge and transition counter; this module stays import-clean of
+# observability (resilience sits below everything, including its logging).
+# Called with old_state=None once per breaker at construction so the gauge
+# exists before any transition. Invoked while the breaker lock is held --
+# observers must not call back into the breaker.
+_observer: Callable[[str, str | None, str], None] | None = None
+
+
+def set_observer(fn: Callable[[str, str | None, str], None] | None) -> None:
+    global _observer
+    _observer = fn
+
+
+def _notify(name: str, old: str | None, new: str) -> None:
+    if _observer is None:
+        return
+    try:
+        _observer(name, old, new)
+    except Exception:  # an observability bug must never break the breaker
+        log.exception("breaker transition observer failed")
+
 
 class CircuitOpenError(RuntimeError):
     """The breaker is open; the protected call was not attempted."""
@@ -59,6 +82,7 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probe_in_flight = False
         self._last_error: BaseException | None = None
+        _notify(self.name, None, self._state)
 
     # -- state --------------------------------------------------------------
 
@@ -85,6 +109,7 @@ class CircuitBreaker:
             self._state = HALF_OPEN
             self._probe_in_flight = False
             log.info("circuit %r: open -> half_open (probing)", self.name)
+            _notify(self.name, OPEN, HALF_OPEN)
 
     def allow(self) -> bool:
         """True when a call may proceed now. In half-open state exactly one
@@ -114,6 +139,7 @@ class CircuitBreaker:
             if self._state != CLOSED:
                 log.info("circuit %r: %s -> closed (dependency recovered)",
                          self.name, self._state)
+                _notify(self.name, self._state, CLOSED)
             self._state = CLOSED
             self._failures = 0
             self._probe_in_flight = False
@@ -131,6 +157,7 @@ class CircuitBreaker:
 
     def _trip(self, why: str, exc: BaseException | None) -> None:
         # caller holds the lock
+        old = self._state
         self._state = OPEN
         self._opened_at = self._clock()
         self._probe_in_flight = False
@@ -140,6 +167,7 @@ class CircuitBreaker:
             f"; last error {type(exc).__name__}: {exc}" if exc else "",
             self.reset_timeout_s,
         )
+        _notify(self.name, old, OPEN)
 
     # -- call wrapper --------------------------------------------------------
 

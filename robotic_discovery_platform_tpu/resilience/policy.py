@@ -19,6 +19,29 @@ class DeadlineExceeded(TimeoutError):
     timeout: a ``Deadline`` spans every retry of a logical operation)."""
 
 
+# Injectable retry observer: (site_name | None, attempt). Installed by
+# observability.instruments (rdp_retry_attempts_total); this module stays
+# import-clean of observability. Fired once per *scheduled* retry, right
+# before its backoff sleep. Must never raise into the retry loop.
+_retry_observer: Callable[[str | None, int], None] | None = None
+
+
+def set_retry_observer(
+    fn: Callable[[str | None, int], None] | None,
+) -> None:
+    global _retry_observer
+    _retry_observer = fn
+
+
+def _notify_retry(name: str | None, attempt: int) -> None:
+    if _retry_observer is None:
+        return
+    try:
+        _retry_observer(name, attempt)
+    except Exception:
+        pass  # observability must never alter retry behavior
+
+
 class Deadline:
     """A monotonic time budget. ``Deadline.after(5.0)`` expires 5 s from
     now; ``remaining()`` never goes below 0.0."""
@@ -121,11 +144,14 @@ class RetryPolicy:
     def call(self, fn: Callable[[], Any], *,
              deadline: Deadline | None = None,
              on_retry: Callable[[int, BaseException, float], None]
-             | None = None) -> Any:
+             | None = None,
+             name: str | None = None) -> Any:
         """Run ``fn`` until it succeeds, a non-retryable error surfaces,
         attempts are exhausted, or the deadline budget cannot fit another
         backoff. Always re-raises the *underlying* error (never a synthetic
-        one) so callers keep their existing except clauses."""
+        one) so callers keep their existing except clauses. ``name`` labels
+        this call site for the process-wide retry observer
+        (:func:`set_retry_observer`)."""
         attempt = 0
         schedule = self.delays()
         while True:
@@ -141,6 +167,7 @@ class RetryPolicy:
                 delay = next(schedule)
                 if deadline is not None and deadline.remaining() <= delay:
                     raise
+                _notify_retry(name, attempt)
                 if on_retry is not None:
                     on_retry(attempt, exc, delay)
                 if delay > 0:

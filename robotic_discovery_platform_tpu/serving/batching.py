@@ -33,6 +33,11 @@ Fault-injection sites (resilience/faults.py): ``serving.batch.collect``
 fires in the collector loop outside the dispatch guard (chaos tests kill the
 collector here), ``serving.batch.dispatch`` fires inside the guard (failed /
 slow batched dispatches).
+
+Observability (observability/ package): queue depth gauge
+(``rdp_batch_queue_depth``), per-dispatch batch-size histogram, watchdog
+restart counter; each submit carries its stream's span context across the
+collector-thread hop so dispatch failures can name the traces they hit.
 """
 
 from __future__ import annotations
@@ -45,6 +50,10 @@ from typing import Any, Callable
 import numpy as np
 
 from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
+from robotic_discovery_platform_tpu.observability import (
+    instruments as obs,
+    trace,
+)
 from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
@@ -66,6 +75,10 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: BaseException | None = None
+    # the submitting stream's span context, carried across the thread hop
+    # (contextvars do not flow into the collector thread) so dispatch-side
+    # logs can name the traces of the frames they affected
+    trace_ctx: Any = None
 
 
 def _bucket(n: int, max_batch: int) -> int:
@@ -144,7 +157,7 @@ class BatchDispatcher:
         (``timeout_s`` if given and tighter, else ``submit_timeout_s``).
         """
         p = _Pending(frame_rgb, depth, np.asarray(intrinsics, np.float32),
-                     float(depth_scale))
+                     float(depth_scale), trace_ctx=trace.current())
         # enqueue under the lock stop() drains under: a submit either lands
         # BEFORE the drain (and is error-completed by it) or observes
         # stopped and raises -- it can never enqueue after the drain and
@@ -160,6 +173,7 @@ class BatchDispatcher:
             with self._pending_lock:
                 self._pending.add(p)
             self._q.put(p)
+            obs.BATCH_QUEUE_DEPTH.set(self._q.qsize())
         timeout = self._submit_timeout_s
         if timeout_s is not None:
             timeout = min(timeout, timeout_s)
@@ -219,6 +233,7 @@ class BatchDispatcher:
                 if self._stopped.is_set():
                     return
                 self.collector_restarts += 1
+                obs.WATCHDOG_RESTARTS.inc()
                 log.error(
                     "batch collector thread died unexpectedly; failing %d "
                     "pending frame(s) and restarting (restart #%d)",
@@ -261,6 +276,7 @@ class BatchDispatcher:
     def _loop(self) -> None:
         while not self._stopped.is_set():
             batch = self._collect()
+            obs.BATCH_QUEUE_DEPTH.set(self._q.qsize())
             if not batch:
                 continue
             # deliberately OUTSIDE _run_group's guard: an injected fault
@@ -277,6 +293,7 @@ class BatchDispatcher:
         try:
             inject("serving.batch.dispatch")
             n = len(group)
+            obs.BATCH_SIZE.observe(n)
             b = _bucket(n, self._max_batch)
             pad = b - n
             frames = np.stack(
@@ -300,7 +317,13 @@ class BatchDispatcher:
                 p.result = jax.tree.map(lambda a, _i=i: a[_i], host)
                 p.done.set()
         except BaseException as exc:  # deliver, don't kill the collector
-            log.exception("batched dispatch failed")
+            log.exception(
+                "batched dispatch failed (affected traces: %s)",
+                ",".join(
+                    p.trace_ctx.trace_id if p.trace_ctx is not None else "-"
+                    for p in group
+                ),
+            )
             for p in group:
                 if not p.done.is_set():
                     p.error = exc

@@ -25,6 +25,7 @@ from robotic_discovery_platform_tpu.io.frames import (
     iter_frames,
     load_calibration,
 )
+from robotic_discovery_platform_tpu.observability import trace
 from robotic_discovery_platform_tpu.resilience import RetryPolicy, inject
 from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
 from robotic_discovery_platform_tpu.utils.config import ClientConfig
@@ -140,35 +141,42 @@ def run_client(
 
     def stream_once():
         inject("client.stream")
-        responses = stub.AnalyzeActuatorPerformance(
-            generate_requests(source, frame_queue, max_frames)
-        )
-        for response in responses:
-            frame = frame_queue.popleft() if frame_queue else None
-            mean_window.append(response.mean_curvature)
-            max_window.append(response.max_curvature)
-            result = FrameResult(
-                mean_curvature=response.mean_curvature,
-                max_curvature=response.max_curvature,
-                smoothed_mean=float(np.mean(mean_window)),
-                smoothed_max=float(np.mean(max_window)),
-                status=response.status,
-                mask_coverage=response.mask_coverage,
-                proc_time_ms=response.proc_time_ms,
-                mask_png=response.mask,
-                spline_points=np.array(
-                    [[p.x, p.y, p.z] for p in response.spline_points]
-                ).reshape(-1, 3),
-                frame_bgr=frame,
+        # one stream = one trace: the span's traceparent rides the call
+        # metadata, the server adopts it, and both sides' log lines carry
+        # the same [trace=...] stamp (a retried stream mints a new trace,
+        # so the two attempts are distinguishable in the logs)
+        with trace.span("client.stream") as sp:
+            log.info("streaming to %s", cfg.server_address)
+            responses = stub.AnalyzeActuatorPerformance(
+                generate_requests(source, frame_queue, max_frames),
+                metadata=trace.to_metadata(sp.context),
             )
-            results.append(result)
-            if display and frame is not None:
-                import cv2
+            for response in responses:
+                frame = frame_queue.popleft() if frame_queue else None
+                mean_window.append(response.mean_curvature)
+                max_window.append(response.max_curvature)
+                result = FrameResult(
+                    mean_curvature=response.mean_curvature,
+                    max_curvature=response.max_curvature,
+                    smoothed_mean=float(np.mean(mean_window)),
+                    smoothed_max=float(np.mean(max_window)),
+                    status=response.status,
+                    mask_coverage=response.mask_coverage,
+                    proc_time_ms=response.proc_time_ms,
+                    mask_png=response.mask,
+                    spline_points=np.array(
+                        [[p.x, p.y, p.z] for p in response.spline_points]
+                    ).reshape(-1, 3),
+                    frame_bgr=frame,
+                )
+                results.append(result)
+                if display and frame is not None:
+                    import cv2
 
-                cv2.imshow("Actuator Analysis (TPU)",
-                           overlay(frame, result, intrinsics, dist))
-                if cv2.waitKey(1) & 0xFF == ord("q"):
-                    break
+                    cv2.imshow("Actuator Analysis (TPU)",
+                               overlay(frame, result, intrinsics, dist))
+                    if cv2.waitKey(1) & 0xFF == ord("q"):
+                        break
 
     def setup_retryable(exc: BaseException) -> bool:
         # only pre-first-response failures the policy itself would retry
@@ -187,7 +195,7 @@ def run_client(
 
     try:
         dataclasses.replace(retry, retryable=setup_retryable).call(
-            stream_once, on_retry=on_retry
+            stream_once, on_retry=on_retry, name="client.stream",
         )
     except grpc.RpcError as exc:
         log.error("rpc failed (%s) -- is the server running at %s?",

@@ -11,6 +11,7 @@ single writer object owns the handle, buffers rows, and flushes under a lock.
 
 from __future__ import annotations
 
+import atexit
 import threading
 import time
 from datetime import datetime, timezone
@@ -29,6 +30,11 @@ class MetricsWriter:
         self._lock = threading.Lock()
         self._buf: list[str] = []
         self._last_flush = time.monotonic()
+        # The drift detector consumes this CSV: rows buffered between
+        # interval flushes must survive a server exit, so the tail is
+        # flushed at interpreter shutdown unless close() already ran.
+        self._closed = False
+        atexit.register(self._flush_at_exit)
         if not self.path.exists():
             self.path.write_text(HEADER + "\n")
 
@@ -59,5 +65,18 @@ class MetricsWriter:
         with self._lock:
             self._flush_locked()
 
+    def _flush_at_exit(self) -> None:
+        if not self._closed:
+            self.flush()
+
     def close(self) -> None:
+        """Flush the buffered tail and drop the atexit registration (a
+        closed writer must not be kept alive, or re-flushed, by interpreter
+        shutdown). Idempotent; the writer stays usable after close -- a
+        late append just buffers and flushes normally."""
         self.flush()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        atexit.unregister(self._flush_at_exit)

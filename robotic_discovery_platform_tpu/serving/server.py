@@ -31,6 +31,17 @@ Resilience (resilience/ package):
   NOT_SERVING when a drain begins;
 - close() drains in-flight streams (bounded by ServerConfig.drain_grace_s)
   before tearing the engines down.
+
+Observability (observability/ package):
+
+- every frame feeds the rdp_* metric families (frames by status, per-stage
+  latency histograms, in-flight streams; the batch dispatcher and the
+  registry breaker export their own) and ``GET /metrics`` serves them in
+  Prometheus text format when ServerConfig.metrics_port / RDP_METRICS_PORT
+  is set -- started here, stopped in close();
+- each stream adopts the client's ``traceparent`` (W3C trace context) from
+  gRPC metadata, so client- and server-side log lines carry the same
+  [trace=...] stamp.
 """
 
 from __future__ import annotations
@@ -46,6 +57,11 @@ import numpy as np
 
 from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.io.frames import load_calibration
+from robotic_discovery_platform_tpu.observability import (
+    exposition,
+    instruments as obs,
+    trace,
+)
 from robotic_discovery_platform_tpu.ops import pipeline
 from robotic_discovery_platform_tpu.resilience import (
     CircuitBreaker,
@@ -189,6 +205,9 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self.metrics = metrics or MetricsWriter(
             cfg.metrics_csv, cfg.metrics_flush_every
         )
+        # Prometheus exposition endpoint; build_server starts one when
+        # cfg.metrics_port / RDP_METRICS_PORT asks for it, close() stops it
+        self.metrics_server: exposition.MetricsServer | None = None
 
     @property
     def variables(self):
@@ -325,9 +344,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             if self._draining or self._closed:
                 return False
             self._active_streams += 1
-            return True
+        obs.INFLIGHT_STREAMS.inc()
+        return True
 
     def _exit_stream(self) -> None:
+        obs.INFLIGHT_STREAMS.dec()
         with self._streams_cond:
             self._active_streams -= 1
             self._streams_cond.notify_all()
@@ -342,11 +363,36 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             context.abort(grpc.StatusCode.UNAVAILABLE,
                           "server is draining; retry against another "
                           "replica")
+        # Adopt the client's trace: the stream runs inside a span whose
+        # trace ID came over the wire (traceparent metadata), so client-
+        # and server-side log lines for the same stream carry the same
+        # [trace=...] stamp. No metadata -> a fresh server-side trace.
+        # (Setting the contextvar inside this generator deliberately leaks
+        # to the handler thread between yields: gRPC drives one stream's
+        # generator from one thread, and log lines emitted while it runs
+        # should carry the stream's trace.)
+        remote = trace.from_metadata(context.invocation_metadata())
         try:
-            # per-stream stage breakdown (decode / device / encode);
+            yield from self._stream_frames(request_iterator, context, remote)
+        finally:
+            self._exit_stream()
+
+    def _stream_frames(self, request_iterator, context, remote):
+        with trace.span("serving.stream", parent=remote):
+            log.info(
+                "analysis stream opened (%s trace)",
+                "client" if remote is not None else "local",
+            )
+            # per-stream stage breakdown (decode / device / encode),
             # summarized at stream end so proc_time_ms has an explanation
-            # in the logs
-            timer = StageTimer()
+            # in the logs -- and routed sample-by-sample into the
+            # rdp_stage_latency_seconds histogram (ONE timing system: the
+            # exported histogram and the log summary observe the same
+            # measurements)
+            timer = StageTimer(
+                observer=lambda stage, dt:
+                    obs.STAGE_LATENCY.labels(stage=stage).observe(dt)
+            )
             for request in request_iterator:
                 # honor cancellation and the client's deadline BEFORE
                 # paying decode + device time for a frame nobody is
@@ -379,11 +425,13 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                         mask_coverage=coverage,
                     )
                     self.metrics.append(mean_k, max_k, coverage)
+                    status_label = "ok" if valid else "degraded"
                 except OverloadedError as exc:
                     # load shedding is a STREAM-level, retryable condition:
                     # surface the standard backpressure status instead of a
                     # per-frame error payload the client cannot distinguish
                     # from a bad frame
+                    obs.FRAMES.labels(status="shed").inc()
                     context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                                   str(exc))
                 except DeadlineExceeded as exc:
@@ -395,18 +443,21 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     response = vision_pb2.AnalysisResponse(
                         status=f"ERROR: DeadlineExceeded: {exc}"
                     )
+                    status_label = "deadline"
                 except Exception as exc:  # keep the stream alive per frame
                     log.exception("analysis error")
                     response = vision_pb2.AnalysisResponse(
                         status=f"ERROR: {type(exc).__name__}: {exc}"
                     )
-                response.proc_time_ms = (time.perf_counter() - t0) * 1e3
+                    status_label = "error"
+                total_s = time.perf_counter() - t0
+                response.proc_time_ms = total_s * 1e3
+                obs.FRAMES.labels(status=status_label).inc()
+                obs.STAGE_LATENCY.labels(stage="total").observe(total_s)
                 yield response
             self.metrics.flush()
             if timer.totals:
                 log.info("stream stage breakdown: %s", timer.summary())
-        finally:
-            self._exit_stream()
 
     # -- hot-reload ---------------------------------------------------------
 
@@ -671,7 +722,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             dispatcher.stop()
         if engine.dispatcher is not None:
             engine.dispatcher.stop()
-        self.metrics.flush()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+        self.metrics.close()
 
 
 def build_server(
@@ -704,6 +758,12 @@ def build_server(
     servicer = VisionAnalysisService(
         model, variables, intrinsics, depth_scale, cfg, geom_cfg,
         version=version,
+    )
+    # /metrics rides the servicer lifecycle: up before the first frame,
+    # down in servicer.close() (cfg.metrics_port / RDP_METRICS_PORT;
+    # off by default)
+    servicer.metrics_server = exposition.maybe_start_metrics_server(
+        cfg.metrics_port
     )
     if warmup_shape is not None:
         servicer.warmup(*warmup_shape)  # flips readiness at the end

@@ -29,6 +29,7 @@ from pathlib import Path
 
 import requests
 
+from robotic_discovery_platform_tpu.observability import instruments as obs
 from robotic_discovery_platform_tpu.resilience import (
     Deadline,
     RetryPolicy,
@@ -116,7 +117,9 @@ class RestMlflowStore:
         """One logical REST operation: every attempt shares a Deadline
         budget, transient failures (connection errors, timeouts, 429, 5xx
         -- resilience.default_retryable) back off and retry, and the
-        underlying error surfaces unchanged once the policy gives up."""
+        underlying error surfaces unchanged once the policy gives up.
+        Every attempt (retries included) lands one sample in the
+        rdp_http_request_seconds histogram, by outcome."""
         deadline = Deadline.after(self.deadline_s, self._retry.clock)
 
         def on_retry(attempt: int, exc: BaseException, delay: float):
@@ -125,7 +128,22 @@ class RestMlflowStore:
                 what, type(exc).__name__, exc, attempt, delay,
             )
 
-        return self._retry.call(fn, deadline=deadline, on_retry=on_retry)
+        def timed_attempt():
+            t0 = time.perf_counter()
+            try:
+                out = fn()
+            except BaseException:
+                obs.HTTP_REQUESTS.labels(outcome="error").observe(
+                    time.perf_counter() - t0
+                )
+                raise
+            obs.HTTP_REQUESTS.labels(outcome="ok").observe(
+                time.perf_counter() - t0
+            )
+            return out
+
+        return self._retry.call(timed_attempt, deadline=deadline,
+                                on_retry=on_retry, name=FAULT_SITE)
 
     def _call(self, method: str, endpoint: str, *, params=None, body=None):
         def attempt():

@@ -33,6 +33,7 @@ from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.analysis import recompile
 from robotic_discovery_platform_tpu.models import losses as losses_lib
 from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
+from robotic_discovery_platform_tpu.observability import instruments as obs
 from robotic_discovery_platform_tpu.training import data as data_lib
 from robotic_discovery_platform_tpu.training.checkpoint import CheckpointManager
 from robotic_discovery_platform_tpu.utils.config import ModelConfig, TrainConfig
@@ -545,6 +546,20 @@ def train_model(
                         )
                         train_losses.append(loss)
                     train_loss = float(np.mean([float(l) for l in train_losses]))
+
+                # Train-phase throughput (the float() above synced the
+                # device, so the measured window covers real step time).
+                # One histogram sample per epoch at the mean step time: the
+                # scan path is one whole-epoch dispatch with no per-step
+                # boundary to time, and the streamed path's per-step wall
+                # time is dispatch-only (losses are fetched at epoch end),
+                # so the epoch mean is the honest per-step number for both.
+                n_steps = (int(order.shape[0]) if use_scan
+                           else len(train_losses))
+                train_time = time.time() - t_epoch
+                if n_steps and train_time > 0:
+                    obs.TRAIN_STEP.observe(train_time / n_steps)
+                    obs.TRAIN_RATE.set(n_steps * batch_size / train_time)
 
                 val = run_val()
                 final_metrics = val

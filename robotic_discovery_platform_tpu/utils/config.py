@@ -166,6 +166,12 @@ class ServerConfig:
     calibration_path: str = "ml/configs/calibration_data.npz"
     metrics_csv: str = "logs/vision_service_metrics.csv"
     metrics_flush_every: int = 32
+    # Prometheus exposition (observability/exposition.py): port for the
+    # stdlib `GET /metrics` endpoint, started/stopped with the gRPC server
+    # lifecycle. 0 (default) = off; negative = bind an ephemeral port
+    # (tests/smoke scripts read it back from servicer.metrics_server.port).
+    # The RDP_METRICS_PORT env var overrides this value.
+    metrics_port: int = 0
     # Cross-stream micro-batching is OFF by default on purpose: measured on
     # v5e, the U-Net forward's per-frame time RISES with batch (b1 0.86 ->
     # b8 1.39 ms/frame; BENCH notes), so batch-1 chained dispatch is already

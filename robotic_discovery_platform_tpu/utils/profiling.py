@@ -10,19 +10,33 @@ capture around compiled steps.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
+from typing import Callable
 
 
 @dataclass
 class StageTimer:
-    """Accumulates wall-clock per named stage; thread-compatible enough for
-    per-stream use (each gRPC stream owns its own timer)."""
+    """Accumulates wall-clock per named stage. Thread-safe: a lock guards
+    every mutation and read of the accumulators, so a timer shared across
+    threads (the serving handler pool) cannot lose updates (the old
+    version was only "per-stream" safe -- two threads racing ``+=`` on the
+    same stage dropped samples).
+
+    ``observer`` routes every closed stage into the metrics registry
+    (``(stage_name, seconds)`` -- serving wires it to the
+    ``rdp_stage_latency_seconds`` histogram), so per-stage timing feeds ONE
+    system: the in-process summary and the exported histogram observe the
+    same measurements. Called outside the lock; must not raise."""
 
     totals: dict = field(default_factory=lambda: defaultdict(float))
     counts: dict = field(default_factory=lambda: defaultdict(int))
     last: dict = field(default_factory=dict)
+    observer: Callable[[str, float], None] | None = None
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -31,20 +45,32 @@ class StageTimer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.totals[name] += dt
-            self.counts[name] += 1
-            self.last[name] = dt
+            with self._lock:
+                self.totals[name] += dt
+                self.counts[name] += 1
+                self.last[name] = dt
+            if self.observer is not None:
+                self.observer(name, dt)
 
     def last_ms(self, *names: str) -> float:
-        return 1e3 * sum(self.last.get(n, 0.0) for n in names)
+        with self._lock:
+            return 1e3 * sum(self.last.get(n, 0.0) for n in names)
 
     def mean_ms(self, name: str) -> float:
+        with self._lock:
+            return self._mean_ms_locked(name)
+
+    def _mean_ms_locked(self, name: str) -> float:
         c = self.counts.get(name, 0)
         return 1e3 * self.totals[name] / c if c else 0.0
 
     def summary(self) -> dict:
-        return {n: {"mean_ms": self.mean_ms(n), "count": self.counts[n]}
-                for n in self.totals}
+        with self._lock:
+            return {
+                n: {"mean_ms": self._mean_ms_locked(n),
+                    "count": self.counts[n]}
+                for n in self.totals
+            }
 
 
 @contextlib.contextmanager

@@ -1,0 +1,392 @@
+"""Observability subsystem: registry semantics, Prometheus text-format
+exposition (golden output), the /metrics endpoint, trace propagation, log
+correlation, and the resilience -> metrics hooks."""
+
+import logging
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from robotic_discovery_platform_tpu.observability import (
+    exposition,
+    instruments,
+    trace,
+)
+from robotic_discovery_platform_tpu.observability.registry import (
+    LATENCY_BUCKETS,
+    REGISTRY,
+    MetricsRegistry,
+    time_histogram,
+)
+from robotic_discovery_platform_tpu.resilience import CircuitBreaker
+from robotic_discovery_platform_tpu.resilience.policy import RetryPolicy
+from robotic_discovery_platform_tpu.utils.profiling import StageTimer
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_counter_inc_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("frames_total", "frames", ("status",))
+    c.labels(status="ok").inc()
+    c.labels(status="ok").inc(2)
+    c.labels(status="error").inc()
+    assert c.labels(status="ok").value == 3
+    assert c.labels(status="error").value == 1
+    with pytest.raises(ValueError):
+        c.labels(status="ok").inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        c.inc()  # labeled family: must go through .labels()
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", "queue depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3
+
+
+def test_registry_get_or_create_and_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "x")
+    assert reg.counter("x_total", "x") is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "x")  # same name, different kind
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "x", ("site",))  # different label schema
+    with pytest.raises(ValueError):
+        reg.counter("0bad", "x")  # invalid metric name
+    with pytest.raises(ValueError):
+        reg.counter("y_total", "y", ("__reserved",))
+
+
+def test_histogram_invariants():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    samples = list(reg.collect()[0].samples())
+    by_le = {
+        dict(s.labels)["le"]: s.value
+        for s in samples if s.suffix == "_bucket"
+    }
+    # cumulative buckets, ending at +Inf == _count
+    assert by_le == {"0.1": 1, "1": 3, "10": 4, "+Inf": 5}
+    assert [s.value for s in samples if s.suffix == "_count"] == [5]
+    (sum_v,) = [s.value for s in samples if s.suffix == "_sum"]
+    assert sum_v == pytest.approx(56.05)
+    # per-le monotone non-decreasing in bucket order
+    values = [s.value for s in samples if s.suffix == "_bucket"]
+    assert values == sorted(values)
+
+
+def test_default_latency_buckets_are_exponential():
+    assert LATENCY_BUCKETS[0] == pytest.approx(0.001)
+    ratios = {
+        round(b / a, 6)
+        for a, b in zip(LATENCY_BUCKETS, LATENCY_BUCKETS[1:])
+    }
+    assert ratios == {2.0}
+
+
+def test_time_histogram_context_manager():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_seconds", "t", buckets=(10.0,))
+    with time_histogram(h):
+        pass
+    with h.time():
+        pass
+    assert h.count == 2
+    assert 0 <= h.sum < 1.0
+
+
+def test_histogram_concurrent_observes():
+    reg = MetricsRegistry()
+    h = reg.histogram("c_seconds", "c", buckets=(1.0,))
+
+    def hammer():
+        for _ in range(1000):
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 8000
+    assert h.sum == pytest.approx(4000.0)
+
+
+# -- exposition --------------------------------------------------------------
+
+
+def test_exposition_golden_output():
+    """Byte-exact render of a small registry against hand-written
+    Prometheus text format 0.0.4: HELP/TYPE headers, label ordering as
+    declared, escaping, histogram bucket/sum/count series."""
+    reg = MetricsRegistry()
+    c = reg.counter("rdp_frames_total", "Frames, by status.", ("status",))
+    c.labels(status="ok").inc(3)
+    c.labels(status="error").inc()
+    g = reg.gauge("rdp_queue_depth", "Queued frames.")
+    g.set(7)
+    h = reg.histogram("rdp_lat_seconds", "Latency.", ("stage",),
+                      buckets=(0.5, 2.5))
+    h.labels(stage="decode").observe(0.3)
+    h.labels(stage="decode").observe(3.0)
+    want = (
+        "# HELP rdp_frames_total Frames, by status.\n"
+        "# TYPE rdp_frames_total counter\n"
+        'rdp_frames_total{status="error"} 1\n'
+        'rdp_frames_total{status="ok"} 3\n'
+        "# HELP rdp_lat_seconds Latency.\n"
+        "# TYPE rdp_lat_seconds histogram\n"
+        'rdp_lat_seconds_bucket{stage="decode",le="0.5"} 1\n'
+        'rdp_lat_seconds_bucket{stage="decode",le="2.5"} 1\n'
+        'rdp_lat_seconds_bucket{stage="decode",le="+Inf"} 2\n'
+        'rdp_lat_seconds_sum{stage="decode"} 3.3\n'
+        'rdp_lat_seconds_count{stage="decode"} 2\n'
+        "# HELP rdp_queue_depth Queued frames.\n"
+        "# TYPE rdp_queue_depth gauge\n"
+        "rdp_queue_depth 7\n"
+    )
+    assert exposition.render(reg) == want
+
+
+def test_exposition_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("esc_total", 'help with \\ and\nnewline', ("path",))
+    c.labels(path='a"b\\c\nd').inc()
+    text = exposition.render(reg)
+    assert '# HELP esc_total help with \\\\ and\\nnewline\n' in text
+    assert 'esc_total{path="a\\"b\\\\c\\nd"} 1\n' in text
+
+
+def test_exposition_renders_sampleless_family_headers():
+    # a labeled family with no children still announces itself (HELP/TYPE)
+    # so scrapers and smoke checks see the full schema
+    reg = MetricsRegistry()
+    reg.counter("quiet_total", "never fired", ("status",))
+    text = exposition.render(reg)
+    assert "# TYPE quiet_total counter\n" in text
+    assert "quiet_total{" not in text
+
+
+def test_metrics_http_server():
+    reg = MetricsRegistry()
+    reg.gauge("up", "server up").set(1)
+    srv = exposition.MetricsServer(0, reg, host="127.0.0.1").start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as resp:
+            assert resp.headers["Content-Type"] == exposition.CONTENT_TYPE
+            body = resp.read().decode()
+        assert "up 1\n" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{url}/other", timeout=5)
+    finally:
+        srv.stop()
+
+
+def test_resolve_metrics_port(monkeypatch):
+    monkeypatch.delenv("RDP_METRICS_PORT", raising=False)
+    assert exposition.resolve_metrics_port(0) is None
+    assert exposition.resolve_metrics_port(9464) == 9464
+    assert exposition.resolve_metrics_port(-1) == 0  # ephemeral
+    monkeypatch.setenv("RDP_METRICS_PORT", "7070")
+    assert exposition.resolve_metrics_port(0) == 7070
+    monkeypatch.setenv("RDP_METRICS_PORT", "0")
+    assert exposition.resolve_metrics_port(9464) is None
+
+
+# -- trace -------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = trace.new_context()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    parsed = trace.parse_traceparent(ctx.traceparent())
+    assert parsed == ctx
+
+
+def test_traceparent_rejects_malformed():
+    for bad in ("", "garbage", "00-short-span-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+                "ff-" + "1" * 32 + "-" + "2" * 16 + "-01"):  # version ff
+        assert trace.parse_traceparent(bad) is None
+
+
+def test_span_nesting_shares_trace_id():
+    assert trace.current() is None
+    with trace.span("outer") as outer:
+        assert trace.current() == outer.context
+        with trace.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.context.span_id != outer.context.span_id
+        assert trace.current() == outer.context
+    assert trace.current() is None
+    assert outer.duration_s is not None and outer.duration_s >= 0
+
+
+def test_span_adopts_explicit_remote_parent():
+    remote = trace.new_context()
+    with trace.span("serving.stream", parent=remote) as sp:
+        assert sp.trace_id == remote.trace_id
+        assert sp.context.span_id != remote.span_id
+
+
+def test_grpc_metadata_roundtrip():
+    ctx = trace.new_context()
+    md = trace.to_metadata(ctx)
+    assert trace.from_metadata(md) == ctx
+    assert trace.from_metadata(None) is None
+    assert trace.from_metadata((("other", "x"),)) is None
+
+
+def test_use_context_and_none_noop():
+    ctx = trace.new_context()
+    with trace.use(ctx):
+        assert trace.current() == ctx
+    assert trace.current() is None
+    with trace.use(None):
+        assert trace.current() is None
+
+
+def test_log_records_carry_trace_id(caplog):
+    trace.install_log_correlation()
+    logger = logging.getLogger("rdp-test")
+    with caplog.at_level(logging.INFO, logger="rdp-test"):
+        logger.info("outside")
+        with trace.span("op") as sp:
+            logger.info("inside")
+    outside, inside = caplog.records
+    assert outside.trace_id == "-"
+    assert inside.trace_id == sp.trace_id
+
+
+# -- resilience hooks --------------------------------------------------------
+
+
+def _breaker_state(name: str) -> float:
+    return instruments.BREAKER_STATE.labels(breaker=name).value
+
+
+def test_breaker_transitions_drive_metrics():
+    instruments.install_resilience_hooks()
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=10.0,
+                        name="registry:test-obs", clock=lambda: now[0])
+    assert _breaker_state("registry:test-obs") == 0  # announced at creation
+    base = instruments.BREAKER_TRANSITIONS.labels(
+        breaker="registry:test-obs", to="open"
+    ).value
+    br.record_failure(RuntimeError("boom"))
+    br.record_failure(RuntimeError("boom"))
+    assert _breaker_state("registry:test-obs") == 1
+    assert instruments.BREAKER_TRANSITIONS.labels(
+        breaker="registry:test-obs", to="open"
+    ).value == base + 1
+    now[0] = 11.0
+    assert br.allow()  # open -> half_open probe admitted
+    assert _breaker_state("registry:test-obs") == 2
+    br.record_success()
+    assert _breaker_state("registry:test-obs") == 0
+
+
+def test_retry_attempts_drive_counter():
+    instruments.install_resilience_hooks()
+    before = instruments.RETRIES.labels(site="test.site").value
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay_s=0.0, jitter=0.0,
+                         sleep=lambda _s: None)
+    assert policy.call(flaky, name="test.site") == "ok"
+    assert instruments.RETRIES.labels(site="test.site").value == before + 2
+
+
+def test_global_registry_exposes_required_families():
+    """The CI scrape smoke asserts these exact names; keep them stable."""
+    text = exposition.render(REGISTRY)
+    for family in ("rdp_frames_total", "rdp_stage_latency_seconds",
+                   "rdp_batch_queue_depth", "rdp_breaker_state",
+                   "rdp_retry_attempts_total", "rdp_http_request_seconds",
+                   "rdp_train_step_seconds"):
+        assert f"# TYPE {family} " in text
+
+
+# -- StageTimer routing + thread safety --------------------------------------
+
+
+def test_stage_timer_observer_routes_to_histogram():
+    reg = MetricsRegistry()
+    h = reg.histogram("stage_seconds", "stages", ("stage",))
+    t = StageTimer(
+        observer=lambda name, dt: h.labels(stage=name).observe(dt)
+    )
+    with t.stage("decode"):
+        pass
+    with t.stage("decode"):
+        pass
+    assert h.labels(stage="decode").count == 2
+    assert t.summary()["decode"]["count"] == 2
+
+
+def test_stage_timer_is_thread_safe():
+    t = StageTimer()
+
+    def hammer():
+        for _ in range(500):
+            with t.stage("s"):
+                pass
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # the old += races dropped counts here
+    assert t.summary()["s"]["count"] == 4000
+
+
+# -- MetricsWriter tail flush ------------------------------------------------
+
+
+def test_metrics_writer_flushes_tail_on_close(tmp_path):
+    from robotic_discovery_platform_tpu.serving.metrics import MetricsWriter
+
+    w = MetricsWriter(tmp_path / "m.csv", flush_every=1000,
+                      flush_interval_s=1000.0)
+    w.append(1.0, 2.0, 3.0)  # buffered: under both flush thresholds
+    w.close()
+    lines = (tmp_path / "m.csv").read_text().strip().splitlines()
+    assert len(lines) == 2  # header + the buffered row survived
+    w.close()  # idempotent
+
+
+def test_metrics_writer_atexit_hook_flushes(tmp_path):
+    import atexit
+
+    from robotic_discovery_platform_tpu.serving.metrics import MetricsWriter
+
+    w = MetricsWriter(tmp_path / "m.csv", flush_every=1000,
+                      flush_interval_s=1000.0)
+    try:
+        w.append(1.0, 2.0, 3.0)
+        # what interpreter shutdown would run for an un-closed writer
+        w._flush_at_exit()
+        lines = (tmp_path / "m.csv").read_text().strip().splitlines()
+        assert len(lines) == 2
+    finally:
+        atexit.unregister(w._flush_at_exit)

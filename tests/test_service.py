@@ -688,3 +688,74 @@ def test_reloader_does_not_touch_global_tracking(tmp_path):
         server.stop(grace=None)
         servicer.close()
         tracking.set_tracking_uri(prev_uri)
+
+
+def test_trace_propagation_client_to_server(running_server, caplog):
+    """One streamed frame produces the SAME trace ID in client-side and
+    server-side log lines (the W3C traceparent rides gRPC metadata; the
+    record factory stamps record.trace_id on both processes' records --
+    in-process here, so both sides land in caplog)."""
+    import logging
+
+    address, _, _ = running_server
+    source = SyntheticSource(width=160, height=120, seed=5, n_frames=1)
+    with caplog.at_level(logging.INFO):
+        client_lib.run_client(
+            ClientConfig(server_address=address,
+                         calibration_path="none.npz"),
+            source=source, max_frames=1,
+        )
+    client_ids = {
+        r.trace_id for r in caplog.records
+        if r.message.startswith("streaming to ")
+    }
+    server_ids = {
+        r.trace_id for r in caplog.records
+        if r.message.startswith("analysis stream opened (client trace)")
+    }
+    assert len(client_ids) == 1 and "-" not in client_ids
+    assert client_ids == server_ids
+
+
+def test_metrics_endpoint_serves_prometheus(registered_model, tmp_path):
+    """With a metrics port configured, GET /metrics returns valid
+    Prometheus text carrying the required families with live samples after
+    frames have streamed (the acceptance-criteria surface)."""
+    import urllib.request
+
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=registered_model,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        metrics_flush_every=1,
+        calibration_path=str(tmp_path / "missing.npz"),
+        metrics_port=-1,  # ephemeral port, read back below
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        assert servicer.metrics_server is not None
+        source = SyntheticSource(width=160, height=120, seed=6, n_frames=3)
+        client_lib.run_client(
+            ClientConfig(server_address=f"localhost:{port}",
+                         calibration_path="none.npz"),
+            source=source, max_frames=3,
+        )
+        url = f"http://127.0.0.1:{servicer.metrics_server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode()
+        for family in ("rdp_frames_total", "rdp_stage_latency_seconds",
+                       "rdp_batch_queue_depth", "rdp_breaker_state"):
+            assert f"# TYPE {family} " in text, family
+        # live per-stage histogram samples from the frames just streamed
+        for stage in ("decode", "device", "encode", "total"):
+            assert (f'rdp_stage_latency_seconds_count{{stage="{stage}"}}'
+                    in text), stage
+        # the registry breaker announced itself (closed = 0)
+        assert 'rdp_breaker_state{breaker="registry:' in text
+        assert "rdp_inflight_streams 0\n" in text
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+        assert servicer.metrics_server is None  # close() stopped it

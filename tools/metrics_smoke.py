@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""CI scrape smoke: boot the real gRPC server with the metrics endpoint
+enabled, stream a few synthetic frames through the real client, scrape
+``GET /metrics`` (with curl when available, so the job exercises the same
+path an external Prometheus would), and assert the required metric
+families are present with live samples.
+
+Run: ``env JAX_PLATFORMS=cpu RDP_METRICS_PORT=9464 python
+tools/metrics_smoke.py`` (any port; ``-1`` binds an ephemeral one).
+Exit code 0 on success, 1 with a diagnostic on any missing family.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+# runnable straight from a checkout, with or without `pip install -e .`
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+REQUIRED_FAMILIES = (
+    "rdp_frames_total",
+    "rdp_stage_latency_seconds",
+    "rdp_batch_queue_depth",
+    "rdp_breaker_state",
+)
+REQUIRED_SAMPLES = (
+    'rdp_stage_latency_seconds_count{stage="total"}',
+    'rdp_frames_total{status="',
+    'rdp_breaker_state{breaker="registry:',
+)
+
+
+def scrape(port: int) -> str:
+    url = f"http://127.0.0.1:{port}/metrics"
+    curl = shutil.which("curl")
+    if curl:
+        return subprocess.run(
+            [curl, "-sf", url], check=True, capture_output=True, text=True,
+            timeout=30,
+        ).stdout
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.read().decode()
+
+
+def main() -> int:
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        force_cpu_platform,
+    )
+
+    force_cpu_platform(min_devices=1)
+
+    import jax
+
+    from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.serving import client as client_lib
+    from robotic_discovery_platform_tpu.serving import server as server_lib
+    from robotic_discovery_platform_tpu.utils.config import (
+        ClientConfig,
+        ModelConfig,
+        ServerConfig,
+    )
+
+    tmp = Path(tempfile.mkdtemp(prefix="rdp-metrics-smoke-"))
+    uri = f"file:{tmp}/mlruns"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = init_unet(model, jax.random.key(0), img_size=64)
+    with tracking.start_run():
+        version = tracking.log_model(
+            variables, mcfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", version
+    )
+
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        metrics_csv=str(tmp / "metrics.csv"),
+        metrics_flush_every=1,
+        calibration_path=str(tmp / "missing.npz"),
+        metrics_port=-1,  # RDP_METRICS_PORT (set by CI) overrides this
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        if servicer.metrics_server is None:
+            print("FAIL: metrics server did not start (set "
+                  "RDP_METRICS_PORT or ServerConfig.metrics_port)")
+            return 1
+        client_lib.run_client(
+            ClientConfig(server_address=f"localhost:{port}",
+                         calibration_path="none.npz"),
+            source=SyntheticSource(width=160, height=120, seed=1,
+                                   n_frames=4),
+            max_frames=4,
+        )
+        text = scrape(servicer.metrics_server.port)
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+
+    missing = [f for f in REQUIRED_FAMILIES if f"# TYPE {f} " not in text]
+    missing += [s for s in REQUIRED_SAMPLES if s not in text]
+    if missing:
+        print("FAIL: /metrics is missing:")
+        for m in missing:
+            print(f"  {m}")
+        print("---- scraped payload ----")
+        print(text)
+        return 1
+    n_lines = len(text.strip().splitlines())
+    print(f"OK: scraped {n_lines} exposition lines; all "
+          f"{len(REQUIRED_FAMILIES)} required families present")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
