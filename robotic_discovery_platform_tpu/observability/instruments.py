@@ -48,7 +48,24 @@ BATCH_SIZE = REGISTRY.histogram(
 )
 WATCHDOG_RESTARTS = REGISTRY.counter(
     "rdp_batch_watchdog_restarts_total",
-    "Times the watchdog restarted a dead batch collector thread.",
+    "Times the watchdog restarted a dead batch collector/completer thread.",
+)
+INFLIGHT_DISPATCHES = REGISTRY.gauge(
+    "rdp_batch_inflight_dispatches",
+    "Batched dispatches launched on the device but not yet completed "
+    "(bounded by ServerConfig.max_inflight_dispatches / RDP_INFLIGHT).",
+)
+DISPATCH_OVERLAP = REGISTRY.histogram(
+    "rdp_batch_overlap_seconds",
+    "Per-dispatch pipeline overlap: how long the previous dispatch was "
+    "still completing (D2H + fan-out) after this one had already "
+    "launched. Identically 0 in serial mode (max_inflight_dispatches=1).",
+)
+BATCH_STAGE_LATENCY = REGISTRY.histogram(
+    "rdp_batch_stage_seconds",
+    "Pipelined dispatcher stage latency: stage (host buffer fill + H2D), "
+    "launch (async jit dispatch), complete (blocking D2H + fan-out).",
+    ("stage",),
 )
 
 # -- resilience --------------------------------------------------------------

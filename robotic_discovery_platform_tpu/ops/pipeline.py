@@ -53,6 +53,27 @@ def _resize_matrix(n_in: int, n_out: int) -> np.ndarray:
     return np.where(in_bounds, weights, 0.0).T.astype(np.float32)
 
 
+def stage_batch(frames_rgb, depths, intrinsics, depth_scales, device=None):
+    """Host->device staging for one padded batch, explicit and OFF the
+    analyzers' critical path.
+
+    The pipelined dispatcher (serving/batching.py) calls this before
+    launching, so the jitted analyzers receive device-resident arrays and
+    their call is pure async launch -- no implicit H2D transfer hides
+    inside the dispatch while the previous batch is still completing.
+    All three batch analyzers accept either host numpy or pre-staged
+    device arrays (jit treats both identically; the ``b == 1`` fast path
+    in ``_analyze_batch`` is unaffected by where the arrays live).
+
+    Returns ``(frames, depths, intrinsics, depth_scales)`` as device
+    arrays. ``jax.device_put`` is itself asynchronous, so staging batch
+    N+1 overlaps batch N's compute.
+    """
+    return jax.device_put(
+        (frames_rgb, depths, intrinsics, depth_scales), device
+    )
+
+
 @shape_contract(frames_rgb="b h w 3", out="b s s 3")
 def preprocess(frames_rgb, img_size: int):
     """uint8 [B, H, W, 3] RGB -> float [B, S, S, 3] in [0, 1].
@@ -170,7 +191,11 @@ def make_batch_analyzer(
     (SURVEY.md section 5.7b).
 
     ``intrinsics`` is [B, 3, 3] and ``depth_scales`` is [B] so streams from
-    different cameras batch correctly.
+    different cameras batch correctly. Inputs may be host numpy or arrays
+    pre-staged with :func:`stage_batch` (the pipelined dispatcher's path);
+    the call returns as soon as the computation is enqueued (async
+    dispatch), so callers that want the result on the host perform the one
+    blocking ``np.asarray`` themselves.
     """
 
     # budget 8: the batching dispatcher pads to power-of-two buckets, so one

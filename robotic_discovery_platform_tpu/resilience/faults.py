@@ -1,8 +1,9 @@
 """Deterministic fault injection at named call sites.
 
 Real call sites (the REST tracking transport, registry resolution, the
-frame analyzer, the batch collector) call ``inject("<site>")`` as their
-first statement. With no faults configured that is a single falsy attribute
+frame analyzer, the batch collector's dispatch guard and the pipelined
+completer's D2H guard) call ``inject("<site>")`` as their first
+statement. With no faults configured that is a single falsy attribute
 check -- production cost is nil. Chaos tests (or an operator running a
 fire-drill) configure faults through the environment:
 

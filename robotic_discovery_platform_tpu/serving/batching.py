@@ -1,4 +1,4 @@
-"""Cross-stream micro-batching for the analysis server.
+"""Cross-stream micro-batching for the analysis server, pipelined.
 
 The reference serves strictly one frame per request, sequentially per stream
 (reference: services/vision_analysis/server.py:116): with 10 worker threads
@@ -8,13 +8,30 @@ module coalesces frames from *concurrent gRPC streams* into one batched
 dispatch (SURVEY.md section 5.7b calls this the single biggest
 serving-throughput lever).
 
-Design: stream handler threads ``submit()`` a frame and block on a
-per-request event; a single collector thread drains the queue, waits at most
-``window_ms`` for co-arriving frames, groups them by (H, W) camera geometry,
-pads each group up to the next power-of-two bucket (so XLA compiles a handful
-of batch shapes, not one per group size), runs the batched fused graph, and
-fans results back out. Padding frames are replicas of the first frame and
-their results are dropped.
+Design: a three-stage pipeline that exploits JAX async dispatch, so the
+device never idles while the host stages or fans out (the classic
+serving-pipeline stall that Clipper-style async dispatch pipelines
+eliminate):
+
+1. **Collector/stager** -- stream handler threads ``submit()`` a frame and
+   block on a per-request event; the collector drains the queue, waits at
+   most ``window_ms`` for co-arriving frames, groups them by (H, W) camera
+   geometry, pads each group up to the next power-of-two bucket into a
+   *preallocated, pooled* host buffer (no fresh ``np.stack`` copies per
+   dispatch), stages it onto the device (``ops.pipeline.stage_batch``),
+   and launches the jitted analyzer WITHOUT waiting for the result --
+   the jit call returns as soon as the computation is enqueued.
+2. **Bounded in-flight window** -- at most ``max_inflight`` dispatches may
+   be launched-but-not-completed at once (``ServerConfig.
+   max_inflight_dispatches``, default 2; ``RDP_INFLIGHT`` overrides), so
+   device memory stays capped while batch N+1's staging and compute
+   overlap batch N's completion. ``max_inflight=1`` is the serial mode:
+   bit-identical results, no overlap.
+3. **Completer** -- a second thread drains finished dispatches in launch
+   order, performs the single blocking D2H (``np.asarray``) off the
+   collector's critical path, and fans results back to the per-stream
+   events. Padding frames are replicas of the first frame and their
+   results are dropped.
 
 Resilience (resilience/ package):
 
@@ -24,29 +41,40 @@ Resilience (resilience/ package):
 - every submit carries a deadline (``submit_timeout_s``, or the caller's
   tighter one) instead of the old unbounded ``done.wait()`` -- a handler
   thread can no longer be parked forever;
-- a watchdog notices a collector thread that died *outside* ``_run_group``'s
-  guard (the one hole in the old design: pending events were never set and
-  every submitter hung), error-completes the stranded frames, and restarts
-  the collector.
+- a watchdog notices a collector OR completer thread that died outside its
+  per-dispatch guard, error-completes the frames stranded in EITHER queue
+  (submit backlog and in-flight completions alike), resets the in-flight
+  window, and restarts the dead stage;
+- ``stop()`` error-completes frames stranded in either queue; no submitter
+  is ever left blocked.
 
 Fault-injection sites (resilience/faults.py): ``serving.batch.collect``
 fires in the collector loop outside the dispatch guard (chaos tests kill the
-collector here), ``serving.batch.dispatch`` fires inside the guard (failed /
-slow batched dispatches).
+collector here), ``serving.batch.dispatch`` fires inside the launch guard
+(failed / slow staging+launch), ``serving.batch.complete`` fires inside the
+completer's guard (failed / slow D2H: the dispatch's frames error-complete,
+the completer keeps draining).
 
 Observability (observability/ package): queue depth gauge
-(``rdp_batch_queue_depth``), per-dispatch batch-size histogram, watchdog
-restart counter; each submit carries its stream's span context across the
+(``rdp_batch_queue_depth``), per-dispatch batch-size histogram,
+in-flight-dispatch gauge (``rdp_batch_inflight_dispatches``), per-dispatch
+overlap histogram (``rdp_batch_overlap_seconds``: how long a completing
+dispatch overlapped the next one's staging/compute), stage-split latency
+(``rdp_batch_stage_seconds``: stage / launch / complete), watchdog restart
+counter; each submit carries its stream's span context across the
 collector-thread hop so dispatch failures can name the traces they hit.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+import jax
 import numpy as np
 
 from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
@@ -54,10 +82,21 @@ from robotic_discovery_platform_tpu.observability import (
     instruments as obs,
     trace,
 )
+from robotic_discovery_platform_tpu.ops import pipeline as pipeline_lib
 from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+_INFLIGHT_ENV_VAR = "RDP_INFLIGHT"
+
+
+def resolve_max_inflight(configured: int) -> int:
+    """The effective in-flight-dispatch cap: ``RDP_INFLIGHT`` when set,
+    else the configured value; never below 1 (1 = serial dispatch)."""
+    raw = os.environ.get(_INFLIGHT_ENV_VAR)
+    value = int(raw) if raw else int(configured)
+    return max(1, value)
 
 
 class OverloadedError(RuntimeError):
@@ -81,6 +120,39 @@ class _Pending:
     trace_ctx: Any = None
 
 
+class _BucketBuffers:
+    """One reusable set of host staging arrays for a (geometry, bucket)
+    key: the collector fills rows in place instead of building fresh
+    ``np.stack`` copies per dispatch. A buffer set is exclusive to one
+    in-flight dispatch (the completer returns it to the pool only after
+    the dispatch's device work is done), so refilling can never race a
+    zero-copy ``device_put`` of a still-executing batch."""
+
+    __slots__ = ("key", "frames", "depths", "intr", "scales")
+
+    def __init__(self, key: tuple, template: _Pending, b: int):
+        h, w = template.frame_rgb.shape[:2]
+        self.key = key
+        self.frames = np.empty((b, h, w, 3), template.frame_rgb.dtype)
+        self.depths = np.empty((b, h, w), template.depth.dtype)
+        self.intr = np.empty((b, 3, 3), np.float32)
+        self.scales = np.empty((b,), np.float32)
+
+
+@dataclass(eq=False)
+class _Dispatch:
+    """A launched-but-not-completed batch riding the completion queue."""
+
+    group: list[_Pending]
+    out: Any  # the analyzer's (possibly still-computing) output tree
+    bufs: _BucketBuffers | None
+    # the in-flight slot this dispatch holds; released by the completer.
+    # Carried per-dispatch so a watchdog window reset can never double-free
+    # a fresh semaphore.
+    slot: threading.Semaphore
+    launch_t: float
+
+
 def _bucket(n: int, max_batch: int) -> int:
     b = 1
     while b < n:
@@ -89,13 +161,15 @@ def _bucket(n: int, max_batch: int) -> int:
 
 
 class BatchDispatcher:
-    """Coalesce concurrent frame analyses into batched dispatches.
+    """Coalesce concurrent frame analyses into pipelined batched dispatches.
 
     Args:
         analyze_batch: ``(frames [B,H,W,3] u8 RGB, depths [B,H,W] u16,
             intrinsics [B,3,3], scales [B]) -> FrameAnalysis`` with leading
             batch dim on every output (ops/pipeline.make_batch_analyzer,
-            already closed over the model variables).
+            already closed over the model variables). Receives pre-staged
+            device arrays; must not block on its own result (jit async
+            dispatch).
         window_ms: how long to hold the first frame of a batch waiting for
             co-arriving frames. The reference's dead ``batch_window_ms`` knob
             (round-1 review) is live here.
@@ -104,29 +178,52 @@ class BatchDispatcher:
             (:class:`OverloadedError`) instead of queuing.
         submit_timeout_s: default per-submit deadline; ``submit`` raises
             ``DeadlineExceeded`` when the result is not back in time.
-        watchdog_interval_s: how often the watchdog checks collector
-            liveness (<= 0 disables the watchdog).
+        watchdog_interval_s: how often the watchdog checks collector +
+            completer liveness (<= 0 disables the watchdog).
+        max_inflight: bounded in-flight window -- how many dispatches may
+            be launched but not yet completed at once. 1 = serial (launch
+            N+1 only after N's results are on the host); 2 (default)
+            overlaps batch N+1's staging/compute with batch N's D2H.
     """
 
     def __init__(self, analyze_batch: Callable, window_ms: float = 2.0,
                  max_batch: int = 8, max_backlog: int = 64,
                  submit_timeout_s: float = 30.0,
-                 watchdog_interval_s: float = 1.0):
+                 watchdog_interval_s: float = 1.0,
+                 max_inflight: int = 2):
         self._analyze = analyze_batch
         self._window_s = window_ms / 1e3
         self._max_batch = max_batch
         self._max_backlog = max_backlog
         self._submit_timeout_s = submit_timeout_s
+        self._max_inflight = max(1, int(max_inflight))
         self._q: queue.Queue[_Pending | None] = queue.Queue()
+        self._cq: queue.Queue[_Dispatch | None] = queue.Queue()
+        self._inflight = threading.Semaphore(self._max_inflight)
+        self._inflight_lock = threading.Lock()
+        self._inflight_count = 0
+        #: high-water mark of concurrently in-flight dispatches; never
+        #: exceeds ``max_inflight`` (tests and the bench assert on this)
+        self.inflight_high_water = 0
+        #: total seconds completed dispatches overlapped the next launch
+        #: (0.0 in serial mode); written only by the completer thread
+        self.overlap_s_total = 0.0
+        self._last_done_t = 0.0
+        # pooled host staging buffers, keyed by (bucket, frame shape/dtype,
+        # depth dtype); free-list only -- buffers in use ride the dispatch
+        self._pool: dict[tuple, list[_BucketBuffers]] = {}
+        self._pool_lock = threading.Lock()
         self._stopped = threading.Event()
         self._submit_lock = threading.Lock()
-        # every not-yet-completed submit, whether still queued or already
-        # popped by the collector: the watchdog error-completes exactly this
-        # set when the collector dies, so a frame caught between _collect()
-        # and _run_group() is covered too
+        # every not-yet-completed submit, whether still queued, staged, or
+        # in flight on the device: the watchdog error-completes exactly
+        # this set when a pipeline stage dies, so a frame caught between
+        # queues is covered too
         self._pending: set[_Pending] = set()
         self._pending_lock = threading.Lock()
         self.collector_restarts = 0
+        self.completer_restarts = 0
+        self._completer = self._start_completer()
         self._thread = self._start_collector()
         self._watchdog: threading.Thread | None = None
         if watchdog_interval_s > 0:
@@ -139,6 +236,13 @@ class BatchDispatcher:
     def _start_collector(self) -> threading.Thread:
         t = threading.Thread(
             target=self._loop, name="batch-dispatcher", daemon=True
+        )
+        t.start()
+        return t
+
+    def _start_completer(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._complete_loop, name="batch-completer", daemon=True
         )
         t.start()
         return t
@@ -191,16 +295,21 @@ class BatchDispatcher:
         return p.result
 
     def stop(self) -> None:
-        """Idempotent. Every pending or racing submit is completed (with a
-        'dispatcher stopped' error if its frame was never dispatched);
-        no caller is left blocked."""
+        """Idempotent. Every pending or racing submit is completed: frames
+        already launched drain through the completer with real results when
+        it is healthy, frames stranded in either queue get a 'dispatcher
+        stopped' error. No caller is left blocked."""
         with self._submit_lock:
             self._stopped.set()
             self._q.put(None)
         self._thread.join(timeout=5)
+        # the completer first drains every dispatch launched before the
+        # sentinel (delivering their real results), then exits
+        self._cq.put(None)
+        self._completer.join(timeout=5)
         if self._watchdog is not None:
             self._watchdog.join(timeout=5)
-        # error-complete anything the collector left behind
+        # error-complete anything either queue still holds
         while True:
             try:
                 item = self._q.get_nowait()
@@ -209,6 +318,18 @@ class BatchDispatcher:
             if item is not None and not item.done.is_set():
                 item.error = RuntimeError("dispatcher stopped")
                 item.done.set()
+        while True:
+            try:
+                d = self._cq.get_nowait()
+            except queue.Empty:
+                break
+            if d is None:
+                continue
+            self._pool_put(d.bufs)
+            for p in d.group:
+                if not p.done.is_set():
+                    p.error = RuntimeError("dispatcher stopped")
+                    p.done.set()
         self._fail_pending(RuntimeError("dispatcher stopped"))
 
     def _fail_pending(self, exc: BaseException) -> None:
@@ -221,47 +342,74 @@ class BatchDispatcher:
     # -- watchdog ------------------------------------------------------------
 
     def _watch(self, interval_s: float) -> None:
-        """Error-complete and restart if the collector ever dies outside
-        ``_run_group``'s guard (e.g. an exception in the grouping /
-        collection code itself): without this, every in-flight submitter
-        of that era would wait out its full deadline for nothing, and all
-        later submits would queue into a threadless dispatcher."""
+        """Error-complete and restart if the collector or completer ever
+        dies outside its per-dispatch guard (e.g. an exception in the
+        grouping / collection / queue code itself): without this, every
+        in-flight submitter of that era would wait out its full deadline
+        for nothing, and all later submits would queue into a threadless
+        pipeline stage."""
         while not self._stopped.wait(interval_s):
-            if self._thread.is_alive():
+            collector_dead = not self._thread.is_alive()
+            completer_dead = not self._completer.is_alive()
+            if not (collector_dead or completer_dead):
                 continue
             with self._submit_lock:
                 if self._stopped.is_set():
                     return
-                self.collector_restarts += 1
+                dead = ("collector" if collector_dead else "completer")
+                if collector_dead:
+                    self.collector_restarts += 1
+                if completer_dead:
+                    self.completer_restarts += 1
                 obs.WATCHDOG_RESTARTS.inc()
                 log.error(
-                    "batch collector thread died unexpectedly; failing %d "
+                    "batch %s thread died unexpectedly; failing %d "
                     "pending frame(s) and restarting (restart #%d)",
-                    len(self._pending), self.collector_restarts,
+                    dead, len(self._pending),
+                    self.collector_restarts + self.completer_restarts,
                 )
-                # drain whatever is queued (the restarted collector starts
-                # from an empty backlog; stranded submitters get an error
-                # now, not a deadline timeout later)
+                # drain BOTH queues (the restarted stages start from an
+                # empty pipeline; stranded submitters get an error now,
+                # not a deadline timeout later), returning pooled buffers
+                # from abandoned in-flight dispatches
                 while True:
                     try:
                         self._q.get_nowait()
                     except queue.Empty:
                         break
-                self._fail_pending(
-                    RuntimeError("batch collector died; frame dropped")
-                )
-                self._thread = self._start_collector()
+                while True:
+                    try:
+                        d = self._cq.get_nowait()
+                    except queue.Empty:
+                        break
+                    if d is not None:
+                        self._pool_put(d.bufs)
+                # fresh in-flight window: slots held by dispatches lost
+                # with the dead stage can never be released (a dispatch
+                # still riding a live completer releases its OWN slot
+                # object, never this new one)
+                self._inflight = threading.Semaphore(self._max_inflight)
+                with self._inflight_lock:
+                    self._inflight_count = 0
+                    obs.INFLIGHT_DISPATCHES.set(0)
+                self._fail_pending(RuntimeError(
+                    f"batch {dead} died; frame dropped"
+                ))
+                if collector_dead:
+                    self._thread = self._start_collector()
+                if completer_dead:
+                    self._completer = self._start_completer()
 
-    # -- collector side -----------------------------------------------------
+    # -- collector / stager side --------------------------------------------
 
     def _collect(self) -> list[_Pending]:
         first = self._q.get()
         if first is None:
             return []
         batch = [first]
-        deadline = _now() + self._window_s
+        deadline = time.monotonic() + self._window_s
         while len(batch) < self._max_batch:
-            remaining = deadline - _now()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             try:
@@ -279,7 +427,7 @@ class BatchDispatcher:
             obs.BATCH_QUEUE_DEPTH.set(self._q.qsize())
             if not batch:
                 continue
-            # deliberately OUTSIDE _run_group's guard: an injected fault
+            # deliberately OUTSIDE the launch guard: an injected fault
             # here kills the collector thread itself, which is exactly the
             # failure mode the watchdog exists for
             inject("serving.batch.collect")
@@ -287,36 +435,136 @@ class BatchDispatcher:
             for p in batch:
                 by_shape.setdefault(p.frame_rgb.shape[:2], []).append(p)
             for group in by_shape.values():
-                self._run_group(group)
+                self._launch_group(group)
 
-    def _run_group(self, group: list[_Pending]) -> None:
+    def _pool_take(self, key: tuple, template: _Pending) -> _BucketBuffers:
+        with self._pool_lock:
+            free = self._pool.get(key)
+            if free:
+                return free.pop()
+        return _BucketBuffers(key, template, key[0])
+
+    def _pool_put(self, bufs: _BucketBuffers | None) -> None:
+        if bufs is None:
+            return
+        with self._pool_lock:
+            self._pool.setdefault(bufs.key, []).append(bufs)
+
+    def _stage_group(self, group: list[_Pending], b: int):
+        """Host-side staging: the padded [b, ...] batch arrays for a group.
+
+        Returns ``(bufs, frames, depths, intr, scales)`` where ``bufs`` is
+        the pooled buffer set to return after the dispatch completes (None
+        for the b == 1 fast path, which returns zero-copy ``[None]`` views
+        of the submitted arrays -- no stack, no pad, no copy). For b > 1
+        the group's rows are filled into a pooled buffer; padding rows
+        (replicas of frame 0) are written only when the bucket is not
+        full -- a full bucket skips the pad work entirely."""
+        n = len(group)
+        first = group[0]
+        if b == 1:
+            return (None, first.frame_rgb[None], first.depth[None],
+                    first.intrinsics[None],
+                    np.asarray([first.depth_scale], np.float32))
+        key = (b, first.frame_rgb.shape, first.frame_rgb.dtype.str,
+               first.depth.dtype.str)
+        bufs = self._pool_take(key, first)
+        for i, p in enumerate(group):
+            bufs.frames[i] = p.frame_rgb
+            bufs.depths[i] = p.depth
+            bufs.intr[i] = p.intrinsics
+            bufs.scales[i] = p.depth_scale
+        if n < b:
+            bufs.frames[n:] = bufs.frames[0]
+            bufs.depths[n:] = bufs.depths[0]
+            bufs.intr[n:] = bufs.intr[0]
+            bufs.scales[n:] = bufs.scales[0]
+        return bufs, bufs.frames, bufs.depths, bufs.intr, bufs.scales
+
+    def _launch_group(self, group: list[_Pending]) -> None:
+        """Stage + H2D + async launch of one geometry group, then hand the
+        in-flight dispatch to the completer. Never blocks on the result."""
+        # bounded in-flight window: dispatch N+1 may not launch until a
+        # slot frees (i.e. at most max_inflight batches hold device memory)
+        slot = self._inflight
+        while not slot.acquire(timeout=0.05):
+            if self._stopped.is_set():
+                self._fail_group(
+                    group, RuntimeError("dispatcher stopped"), log_it=False
+                )
+                return
+        bufs = None
+        launched = False
         try:
             inject("serving.batch.dispatch")
             n = len(group)
             obs.BATCH_SIZE.observe(n)
             b = _bucket(n, self._max_batch)
-            pad = b - n
-            frames = np.stack(
-                [p.frame_rgb for p in group] + [group[0].frame_rgb] * pad
-            )
-            depths = np.stack(
-                [p.depth for p in group] + [group[0].depth] * pad
-            )
-            intr = np.stack(
-                [p.intrinsics for p in group] + [group[0].intrinsics] * pad
-            )
-            scales = np.asarray(
-                [p.depth_scale for p in group]
-                + [group[0].depth_scale] * pad, np.float32,
-            )
-            out = self._analyze(frames, depths, intr, scales)
-            import jax
-
-            host = jax.tree.map(np.asarray, out)
-            for i, p in enumerate(group):
-                p.result = jax.tree.map(lambda a, _i=i: a[_i], host)
-                p.done.set()
+            t0 = time.monotonic()
+            bufs, frames, depths, intr, scales = self._stage_group(group, b)
+            staged = pipeline_lib.stage_batch(frames, depths, intr, scales)
+            t1 = time.monotonic()
+            # jit async dispatch: returns once the computation is enqueued
+            out = self._analyze(*staged)
+            t2 = time.monotonic()
+            obs.BATCH_STAGE_LATENCY.labels(stage="stage").observe(t1 - t0)
+            obs.BATCH_STAGE_LATENCY.labels(stage="launch").observe(t2 - t1)
+            with self._inflight_lock:
+                self._inflight_count += 1
+                self.inflight_high_water = max(
+                    self.inflight_high_water, self._inflight_count
+                )
+                obs.INFLIGHT_DISPATCHES.set(self._inflight_count)
+            self._cq.put(_Dispatch(group, out, bufs, slot, t2))
+            launched = True
         except BaseException as exc:  # deliver, don't kill the collector
+            self._fail_group(group, exc)
+            self._pool_put(bufs)
+        finally:
+            if not launched:
+                slot.release()
+
+    # -- completer side -----------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        while True:
+            d = self._cq.get()
+            if d is None:
+                return
+            t_pop = time.monotonic()
+            try:
+                inject("serving.batch.complete")
+                # the ONE blocking host fetch, off the collector's critical
+                # path: batch N+1 is already staging/computing while this
+                # D2H + fan-out runs
+                host = jax.tree.map(np.asarray, d.out)
+                for i, p in enumerate(d.group):
+                    p.result = jax.tree.map(lambda a, _i=i: a[_i], host)
+                    p.done.set()
+            except BaseException as exc:  # deliver, keep draining
+                self._fail_group(d.group, exc)
+            finally:
+                done_t = time.monotonic()
+                # overlap: how long this dispatch's predecessor was still
+                # completing after this one had already launched. Serial
+                # mode (max_inflight=1) launches only after the previous
+                # completion, so this is identically 0 there.
+                overlap = max(0.0, self._last_done_t - d.launch_t)
+                self._last_done_t = done_t
+                self.overlap_s_total += overlap
+                obs.DISPATCH_OVERLAP.observe(overlap)
+                obs.BATCH_STAGE_LATENCY.labels(stage="complete").observe(
+                    done_t - t_pop
+                )
+                self._pool_put(d.bufs)
+                with self._inflight_lock:
+                    self._inflight_count = max(0, self._inflight_count - 1)
+                    obs.INFLIGHT_DISPATCHES.set(self._inflight_count)
+                d.slot.release()
+
+    def _fail_group(self, group: list[_Pending], exc: BaseException,
+                    log_it: bool = True) -> None:
+        if log_it:
             log.exception(
                 "batched dispatch failed (affected traces: %s)",
                 ",".join(
@@ -324,13 +572,7 @@ class BatchDispatcher:
                     for p in group
                 ),
             )
-            for p in group:
-                if not p.done.is_set():
-                    p.error = exc
-                    p.done.set()
-
-
-def _now() -> float:
-    import time
-
-    return time.monotonic()
+        for p in group:
+            if not p.done.is_set():
+                p.error = exc
+                p.done.set()

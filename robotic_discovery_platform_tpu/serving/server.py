@@ -25,7 +25,12 @@ Resilience (resilience/ package):
   server's warning silence another's for 60 s);
 - each frame honors the client's gRPC deadline and cancellation BEFORE
   paying decode + device time, and dispatcher submits carry that deadline;
-- an overloaded batch dispatcher sheds load with RESOURCE_EXHAUSTED;
+- an overloaded batch dispatcher sheds load with RESOURCE_EXHAUSTED; the
+  dispatcher itself is pipelined (serving/batching.py: collector/stager ->
+  bounded in-flight window -> completer), with
+  ServerConfig.max_inflight_dispatches / RDP_INFLIGHT capping how many
+  batches hold device memory at once, and its stop() drains both pipeline
+  queues so close()/hot-reload teardown never strands a frame;
 - the standard grpc.health.v1 health service (serving/health.py) reports
   readiness, flipping to SERVING only after model warm-up and back to
   NOT_SERVING when a drain begins;
@@ -236,6 +241,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         if cfg.batch_window_ms > 0:
             from robotic_discovery_platform_tpu.serving.batching import (
                 BatchDispatcher,
+                resolve_max_inflight,
             )
 
             if cfg.batch_impl == "dense":
@@ -257,6 +263,9 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 max_backlog=cfg.max_backlog,
                 submit_timeout_s=cfg.submit_deadline_s,
                 watchdog_interval_s=cfg.watchdog_interval_s,
+                max_inflight=resolve_max_inflight(
+                    cfg.max_inflight_dispatches
+                ),
             )
         return Engine(analyze, variables, dispatcher, version)
 

@@ -183,6 +183,26 @@ def make_epoch_runners(model, tx, loss_fn: Callable, donate: bool = True):
     )
 
 
+def prefetch_to_device(batches, put):
+    """Stage batch k+1 onto the device while batch k's (async-dispatched,
+    donated) train step runs: the generator keeps exactly one staged batch
+    ahead, so host decode + H2D transfer overlap device compute instead of
+    serializing into every step -- the training-side twin of the serving
+    dispatcher's pipelined staging (serving/batching.py). ``put`` is the
+    device placement (``jnp.asarray`` single-device,
+    ``parallel.put_global_batch`` under a mesh); ``jax.device_put`` /
+    ``jnp.asarray`` are themselves asynchronous, so staging costs the host
+    only the enqueue."""
+    staged = None
+    for bx, by in batches:
+        nxt = (put(bx), put(by))
+        if staged is not None:
+            yield staged
+        staged = nxt
+    if staged is not None:
+        yield staged
+
+
 #: Independent device buffers for a pytree: safe to hold across later
 #: donated train steps, and checkpointable as (possibly sharded) global
 #: arrays. jit outputs never alias non-donated inputs, so every leaf is a
@@ -540,10 +560,13 @@ def train_model(
                     train_loss = float(loss)
                 else:
                     train_losses = []
-                    for bx, by in train_batches:
-                        state, loss = train_step(
-                            state, to_device(bx), to_device(by)
-                        )
+                    # device-prefetch: batch k+1 decodes + stages while the
+                    # donated step for batch k runs on device (losses are
+                    # fetched at epoch end, so nothing here blocks per step)
+                    for dx, dy in prefetch_to_device(
+                        train_batches, to_device
+                    ):
+                        state, loss = train_step(state, dx, dy)
                         train_losses.append(loss)
                     train_loss = float(np.mean([float(l) for l in train_losses]))
 

@@ -188,6 +188,16 @@ class ServerConfig:
     # (measured anti-scaling: B=4 349.5 vs B=1 501.5 aggregate FPS), while
     # still amortizing per-dispatch overhead. bench.py measures both.
     batch_impl: str = "dense"
+    # Pipelined dispatch window: how many batched dispatches may be
+    # launched-but-not-completed at once (serving/batching.py). 2 (default)
+    # overlaps batch N+1's host staging + H2D + compute with batch N's D2H
+    # completion; 1 selects the serial mode (launch only after the previous
+    # batch's results reached the host) -- bit-identical results, no
+    # overlap. Each in-flight dispatch holds one padded batch of
+    # activations on the device, so depth > 2 mostly buys VMEM/HBM
+    # pressure, not throughput, unless completion (D2H + fan-out) is the
+    # bottleneck. The RDP_INFLIGHT env var overrides this value.
+    max_inflight_dispatches: int = 2
     # Geometry decimation stride (GeometryConfig.stride). 1 = reference-
     # exact dense semantics, the DEFAULT: serving numerics match the
     # reference out of the box. 2 is the opt-in fast profile -- it quarters

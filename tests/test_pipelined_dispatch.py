@@ -1,0 +1,375 @@
+"""The pipelined batch dispatcher (serving/batching.py): staging buffer
+pool (no per-dispatch np.stack copies, zero-copy b==1 fast path), bounded
+in-flight window (gauge never exceeds the cap), per-stream correctness
+under concurrent submits, completer fault isolation
+(``serving.batch.complete``), watchdog coverage of BOTH pipeline stages,
+and stop() draining both queues without stranding a submitter."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.resilience import configure_faults
+from robotic_discovery_platform_tpu.serving import batching as batching_lib
+from robotic_discovery_platform_tpu.serving.batching import (
+    BatchDispatcher,
+    resolve_max_inflight,
+)
+
+_FRAME = np.zeros((8, 8, 3), np.uint8)
+_DEPTH = np.zeros((8, 8), np.uint16)
+_K = np.eye(3, dtype=np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    configure_faults(None)
+
+
+class _LazyResult:
+    """A result leaf whose host fetch (``np.asarray`` ->  ``__array__``)
+    blocks until released: simulates device compute still in flight when
+    the completer pops the dispatch, exactly like a real async-dispatched
+    jax.Array."""
+
+    def __init__(self, value: np.ndarray, gate: threading.Event):
+        self._value = value
+        self._gate = gate
+
+    def __array__(self, dtype=None, copy=None):
+        self._gate.wait(30.0)
+        return np.asarray(self._value, dtype)
+
+
+def _sum_analyze(gate: threading.Event | None = None):
+    """Per-frame checksum analyzer: result[i] == frames[i].sum(), so each
+    submitter can verify it got ITS frame's slice back. Optionally gated
+    through _LazyResult so completion lags launch."""
+
+    def analyze(frames, depths, intr, scales):
+        f = np.asarray(frames)
+        sums = f.reshape(f.shape[0], -1).sum(axis=1).astype(np.int64)
+        if gate is not None:
+            return {"sum": _LazyResult(sums, gate)}
+        return {"sum": sums}
+
+    return analyze
+
+
+def _frame(v: int) -> np.ndarray:
+    return np.full((8, 8, 3), v, np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# staging: pooled buffers, pad skipping, zero-copy fast path
+# ---------------------------------------------------------------------------
+
+
+def test_stage_group_b1_is_zero_copy():
+    d = BatchDispatcher(_sum_analyze(), window_ms=1.0, max_batch=4,
+                        watchdog_interval_s=0.0)
+    try:
+        p = batching_lib._Pending(_frame(7), _DEPTH, _K, 0.001)
+        bufs, frames, depths, intr, scales = d._stage_group([p], 1)
+        assert bufs is None  # no pooled buffer, no stack, no pad
+        assert np.shares_memory(frames, p.frame_rgb)
+        assert np.shares_memory(depths, p.depth)
+        assert np.shares_memory(intr, p.intrinsics)
+        assert frames.shape == (1, 8, 8, 3)
+    finally:
+        d.stop()
+
+
+def test_stage_group_reuses_pooled_buffer_and_skips_pad_for_full_bucket():
+    d = BatchDispatcher(_sum_analyze(), window_ms=1.0, max_batch=4,
+                        watchdog_interval_s=0.0)
+    try:
+        group = [batching_lib._Pending(_frame(i), _DEPTH, _K, 0.001) for i in (1, 2)]
+        bufs, frames, *_ = d._stage_group(group, 2)
+        assert bufs is not None and frames is bufs.frames
+        np.testing.assert_array_equal(frames[0], _frame(1))
+        np.testing.assert_array_equal(frames[1], _frame(2))
+        first = bufs
+        # returning the buffer and restaging must REUSE the preallocated
+        # set (identity), not build fresh np.stack copies
+        d._pool_put(bufs)
+        bufs2, frames2, *_ = d._stage_group(group, 2)
+        assert bufs2 is first
+        # partial bucket: pad rows replicate frame 0
+        group3 = [batching_lib._Pending(_frame(i), _DEPTH, _K, 0.001) for i in (5, 6, 7)]
+        d._pool_put(bufs2)
+        bufs4, frames4, depths4, intr4, scales4 = d._stage_group(group3, 4)
+        np.testing.assert_array_equal(frames4[3], _frame(5))
+        np.testing.assert_array_equal(depths4[3], _DEPTH)
+        assert scales4[3] == np.float32(0.001)
+    finally:
+        d.stop()
+
+
+def test_bucket_sizes():
+    assert [batching_lib._bucket(n, 8) for n in (1, 2, 3, 4, 5, 8, 9)] == [
+        1, 2, 4, 4, 8, 8, 8]
+
+
+# ---------------------------------------------------------------------------
+# pipelined correctness + bounded window
+# ---------------------------------------------------------------------------
+
+
+def test_per_stream_results_correct_under_concurrent_submits():
+    d = BatchDispatcher(_sum_analyze(), window_ms=2.0, max_batch=4,
+                        max_inflight=2)
+    try:
+        results: dict[int, list[int]] = {}
+
+        def stream(sid: int):
+            got = []
+            for _ in range(6):
+                out = d.submit(_frame(sid), _DEPTH, _K, 0.001)
+                got.append(int(out["sum"]))
+            results[sid] = got
+
+        threads = [threading.Thread(target=stream, args=(s,))
+                   for s in range(1, 7)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert set(results) == set(range(1, 7))
+        for sid, got in results.items():
+            # every frame of stream sid mapped back to ITS checksum, in
+            # submit order
+            assert got == [8 * 8 * 3 * sid] * 6
+    finally:
+        d.stop()
+
+
+def test_inflight_window_never_exceeds_cap_and_pipelines():
+    gate = threading.Event()
+    d = BatchDispatcher(_sum_analyze(gate), window_ms=1.0, max_batch=2,
+                        max_inflight=2)
+    samples: list[float] = []
+    stop_sampling = threading.Event()
+
+    def sample():
+        while not stop_sampling.is_set():
+            samples.append(obs.INFLIGHT_DISPATCHES.value)
+            time.sleep(0.002)
+
+    sampler = threading.Thread(target=sample)
+    sampler.start()
+    try:
+        outcomes: list = []
+
+        def submit_one(v):
+            outcomes.append(int(d.submit(_frame(v), _DEPTH, _K, 0.001,
+                                         timeout_s=30.0)["sum"]))
+
+        threads = [threading.Thread(target=submit_one, args=(v,))
+                   for v in range(1, 7)]
+        for t in threads:
+            t.start()
+        # completion is gated: the collector should launch up to the cap
+        # and then block on the window, never beyond it
+        deadline = time.monotonic() + 10
+        while d.inflight_high_water < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(outcomes) == 6
+        assert d.inflight_high_water == 2  # the pipeline actually filled
+        assert max(samples) <= 2  # the gauge never exceeded the cap
+        assert d.overlap_s_total > 0.0  # completion overlapped a launch
+    finally:
+        stop_sampling.set()
+        sampler.join(timeout=5)
+        gate.set()
+        d.stop()
+
+
+def test_serial_mode_has_zero_overlap():
+    d = BatchDispatcher(_sum_analyze(), window_ms=1.0, max_batch=2,
+                        max_inflight=1)
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda v=v: d.submit(_frame(v), _DEPTH, _K, 0.001))
+            for v in range(1, 5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert d.inflight_high_water == 1
+        assert d.overlap_s_total == 0.0
+    finally:
+        d.stop()
+
+
+def test_resolve_max_inflight_env_override(monkeypatch):
+    assert resolve_max_inflight(2) == 2
+    monkeypatch.setenv("RDP_INFLIGHT", "4")
+    assert resolve_max_inflight(2) == 4
+    monkeypatch.setenv("RDP_INFLIGHT", "0")
+    assert resolve_max_inflight(2) == 1  # clamped to serial, never 0
+    monkeypatch.delenv("RDP_INFLIGHT")
+    assert resolve_max_inflight(0) == 1
+
+
+# ---------------------------------------------------------------------------
+# failure paths: completer fault site, stage death, stop()
+# ---------------------------------------------------------------------------
+
+
+def test_completer_fault_error_completes_frames_and_keeps_serving():
+    """The ``serving.batch.complete`` fault site fires INSIDE the
+    completer's per-dispatch guard: the dispatch's frames error-complete
+    and the completer keeps draining later dispatches (no restart)."""
+    configure_faults("serving.batch.complete:exc:1")
+    d = BatchDispatcher(_sum_analyze(), window_ms=1.0, max_batch=4)
+    try:
+        with pytest.raises(RuntimeError, match="injected fault"):
+            d.submit(_FRAME, _DEPTH, _K, 0.001, timeout_s=30.0)
+        out = d.submit(_frame(3), _DEPTH, _K, 0.001, timeout_s=30.0)
+        assert int(out["sum"]) == 8 * 8 * 3 * 3
+        assert d.completer_restarts == 0  # guarded: the thread survived
+    finally:
+        d.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_collector_death_with_dispatches_in_flight_fails_both_queues():
+    """Collector dies while dispatches are still completing: the watchdog
+    must error-complete frames stranded in the submit queue AND the
+    in-flight completion queue, reset the window, and restart."""
+    gate = threading.Event()
+    d = BatchDispatcher(_sum_analyze(gate), window_ms=1.0, max_batch=1,
+                        max_inflight=2, watchdog_interval_s=0.05)
+    try:
+        errors: list[BaseException] = []
+
+        def submit_bg():
+            try:
+                d.submit(_FRAME, _DEPTH, _K, 0.001, timeout_s=30.0)
+            except BaseException as exc:
+                errors.append(exc)
+
+        # two dispatches launch and sit gated in/behind the completer
+        inflight = [threading.Thread(target=submit_bg) for _ in range(2)]
+        for t in inflight:
+            t.start()
+        deadline = time.monotonic() + 10
+        while d.inflight_high_water < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # now kill the collector on its next batch
+        configure_faults("serving.batch.collect:exc:1")
+        trigger = threading.Thread(target=submit_bg)
+        trigger.start()
+        for t in inflight + [trigger]:
+            t.join(timeout=30)
+        assert len(errors) == 3  # in-flight frames AND the queued one
+        assert all("collector died" in str(e) for e in errors)
+        assert d.collector_restarts == 1
+        gate.set()
+        # restarted pipeline serves again with a fresh in-flight window
+        out = d.submit(_frame(2), _DEPTH, _K, 0.001, timeout_s=30.0)
+        assert int(out["sum"]) == 8 * 8 * 3 * 2
+    finally:
+        gate.set()
+        d.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_completer_death_restarts_and_recovers():
+    """A completer killed outside its guard (poisoned queue entry) is
+    restarted by the watchdog; pending frames error-complete and later
+    submits are served by the fresh completer."""
+    d = BatchDispatcher(_sum_analyze(), window_ms=1.0, max_batch=4,
+                        watchdog_interval_s=0.05)
+    try:
+        d._cq.put(object())  # not a _Dispatch: kills the thread
+        deadline = time.monotonic() + 10
+        while d.completer_restarts == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert d.completer_restarts == 1
+        out = d.submit(_frame(4), _DEPTH, _K, 0.001, timeout_s=30.0)
+        assert int(out["sum"]) == 8 * 8 * 3 * 4
+    finally:
+        d.stop()
+
+
+def test_stop_drains_both_queues_and_leaves_no_blocked_submitter():
+    gate = threading.Event()
+    d = BatchDispatcher(_sum_analyze(gate), window_ms=1.0, max_batch=1,
+                        max_inflight=1)
+    try:
+        outcomes: dict[int, object] = {}
+
+        def submit_bg(v):
+            try:
+                outcomes[v] = int(
+                    d.submit(_frame(v), _DEPTH, _K, 0.001,
+                             timeout_s=30.0)["sum"])
+            except BaseException as exc:
+                outcomes[v] = exc
+
+        # frame 1 launches (gated in the completer), frame 2 blocks on the
+        # serial window, frames 3-4 sit in the submit queue
+        threads = [threading.Thread(target=submit_bg, args=(v,))
+                   for v in (1, 2, 3, 4)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)
+        stopper = threading.Thread(target=d.stop)
+        stopper.start()
+        time.sleep(0.2)
+        gate.set()  # let the in-flight dispatch finish its D2H
+        stopper.join(timeout=30)
+        for t in threads:
+            t.join(timeout=30)
+        assert set(outcomes) == {1, 2, 3, 4}
+        # the launched frame drained with its REAL result; every frame
+        # stranded in either queue got a clean error -- nobody hung
+        assert outcomes[1] == 8 * 8 * 3 * 1
+        for v in (2, 3, 4):
+            assert isinstance(outcomes[v], RuntimeError), outcomes[v]
+            assert "dispatcher stopped" in str(outcomes[v])
+        with pytest.raises(RuntimeError, match="dispatcher stopped"):
+            d.submit(_FRAME, _DEPTH, _K, 0.001)
+    finally:
+        gate.set()
+
+
+# ---------------------------------------------------------------------------
+# training-side prefetch (the minor pipelining leg)
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_prefetch_preserves_order_and_stays_one_ahead():
+    from robotic_discovery_platform_tpu.training.trainer import (
+        prefetch_to_device,
+    )
+
+    staged: list[int] = []
+
+    def put(v):
+        staged.append(v)
+        return v * 10
+
+    batches = [(i, i) for i in range(5)]
+    seen = []
+    it = prefetch_to_device(iter(batches), put)
+    for dx, dy in it:
+        seen.append((dx, dy))
+        # by the time batch k is yielded, batch k+1 is already staged
+        assert len(staged) >= min(2 * (len(seen) + 1), 2 * len(batches))
+    assert seen == [(i * 10, i * 10) for i in range(5)]
+    assert list(prefetch_to_device(iter([]), put)) == []
+    assert list(prefetch_to_device(iter([(9, 9)]), put)) == [(90, 90)]
