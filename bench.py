@@ -361,7 +361,8 @@ def main() -> None:
     })
 
 
-def serving_pipeline_main(smoke: bool = False) -> None:
+def serving_pipeline_main(smoke: bool = False, chips: int = 1,
+                          dispatch_mode: str = "round_robin") -> None:
     """serving_pipeline_fps: N synthetic concurrent streams through the
     LIVE BatchDispatcher (serving/batching.py), pipelined
     (max_inflight=2) vs serial (pipeline_depth=1), reporting aggregate
@@ -369,14 +370,26 @@ def serving_pipeline_main(smoke: bool = False) -> None:
     the in-flight high-water mark, and a bitwise per-stream parity check
     between the two modes.
 
+    ``chips > 1`` additionally routes the pipelined run across a
+    ``make_serving_mesh(chips)`` device mesh (DeviceRouter, round_robin
+    or sharded per ``dispatch_mode``) and reports aggregate + per-chip
+    FPS, per-chip dispatch balance, and scaling efficiency vs the 1-chip
+    pipelined figure; parity stays bitwise against single-chip serial.
+
     ``smoke`` is the CPU-runnable variant (tiny model, 64x64 frames) CI
-    runs -- including under RDP_FAULTS="serving.batch.complete:exc:1",
-    where the injected completer fault must error-complete its frames and
-    leave the dispatcher serving (errored_frames >= 1, value > 0).
+    runs -- with ``--chips N`` it exercises the multi-chip path on faked
+    CPU devices (XLA_FLAGS=--xla_force_host_platform_device_count) --
+    including under RDP_FAULTS="serving.batch.complete:exc:1", where the
+    injected completer fault must error-complete its frames and leave the
+    dispatcher serving (errored_frames >= 1, value > 0).
     """
     from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
     from robotic_discovery_platform_tpu.ops import pipeline
-    from robotic_discovery_platform_tpu.serving.batching import BatchDispatcher
+    from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+    from robotic_discovery_platform_tpu.serving.batching import (
+        BatchDispatcher,
+        DeviceRouter,
+    )
     from robotic_discovery_platform_tpu.utils.config import ModelConfig
 
     if smoke:
@@ -385,6 +398,10 @@ def serving_pipeline_main(smoke: bool = False) -> None:
     else:
         h, w, img_size, base = 480, 640, 256, 64
         streams, frames_per_stream, parity_frames = 8, 24, 8
+    if chips > 1:
+        # enough concurrent submitters to keep every chip's window fed
+        streams = max(streams, 4 * chips)
+        frames_per_stream = max(frames_per_stream, 12)
     max_inflight = 2
 
     mcfg = ModelConfig(base_features=base, compute_dtype="float32")
@@ -394,6 +411,24 @@ def serving_pipeline_main(smoke: bool = False) -> None:
 
     def analyze(frames, depths, intr, scales):
         return batch_analyze(variables, frames, depths, intr, scales)
+
+    def make_router() -> DeviceRouter:
+        """Mesh + per-placement analyzers, mirroring the server's
+        _make_engine: weights are bound to each chip (or mesh-replicated)
+        once, never re-transferred per dispatch."""
+        mesh = mesh_lib.make_serving_mesh(chips)
+        if dispatch_mode == "round_robin":
+            analyzers = [
+                (lambda f, d_, i, s, _v=v: batch_analyze(_v, f, d_, i, s))
+                for v in (jax.device_put(variables, dev)
+                          for dev in mesh_lib.device_ring(mesh))
+            ]
+        else:
+            v_repl = mesh_lib.shard_pytree(mesh, variables)
+            analyzers = [
+                lambda f, d_, i, s: batch_analyze(v_repl, f, d_, i, s)
+            ]
+        return DeviceRouter(mesh, dispatch_mode, analyzers)
 
     rng = np.random.default_rng(0)
     depth = np.full((h, w), 500, np.uint16)
@@ -425,25 +460,33 @@ def serving_pipeline_main(smoke: bool = False) -> None:
                 return False
         return True
 
-    def run_mode(inflight: int) -> dict:
+    def run_mode(inflight: int, router=None) -> dict:
+        # sharded routing needs max_batch to cover the mesh width; the
+        # round-robin and single-chip runs keep the smoke's b<=2 buckets
+        mb = (max(2, router.chips)
+              if router is not None and router.mode == "sharded" else 2)
         d = BatchDispatcher(
-            analyze, window_ms=2.0, max_batch=2, max_backlog=256,
-            submit_timeout_s=300.0, max_inflight=inflight,
+            analyze, window_ms=2.0, max_batch=mb, max_backlog=1024,
+            submit_timeout_s=300.0, max_inflight=inflight, router=router,
         )
         errored = 0
         try:
-            # warm-up submit: pays the b=1 compile and absorbs any injected
-            # completer fault (CI's graceful-degradation proof)
+            # warm-up submit: pays its bucket's compile on the first routed
+            # chip and absorbs any injected completer fault (CI's
+            # graceful-degradation proof)
             try:
                 d.submit(parity_set[0], depth, intr, 0.001)
             except Exception:
                 errored += 1
-            # warm the b=2 bucket off the timed path
-            np_pair = np.stack([parity_set[0], parity_set[0]])
-            jax.tree.map(np.asarray, analyze(
-                np_pair, np.stack([depth, depth]),
-                np.stack([intr, intr]), np.full((2,), 0.001, np.float32),
-            ))
+            # warm every reachable bucket on EVERY routed placement off
+            # the timed path
+            for b in sorted({d.bucket_for(n) for n in range(1, mb + 1)}):
+                d.warm(
+                    np.stack([parity_set[0]] * b),
+                    np.stack([depth] * b),
+                    np.stack([intr] * b),
+                    np.full((b,), 0.001, np.float32),
+                )
             # parity phase: sequential b=1 submits, results kept for the
             # cross-mode bitwise comparison
             parity = []
@@ -468,6 +511,7 @@ def serving_pipeline_main(smoke: bool = False) -> None:
             threads = [threading.Thread(target=stream, args=(s,))
                        for s in range(streams)]
             overlap0 = d.overlap_s_total
+            frames0 = list(d.chip_frames)
             t0 = time.perf_counter()
             for t in threads:
                 t.start()
@@ -481,26 +525,43 @@ def serving_pipeline_main(smoke: bool = False) -> None:
                 "high_water": d.inflight_high_water,
                 "errored": errored,
                 "parity": parity,
+                "wall": wall,
+                # throughput-phase frames per chip (parity/warm excluded)
+                "chip_frames": [a - b for a, b in
+                                zip(d.chip_frames, frames0)],
+                "chip_dispatches": list(d.chip_dispatches),
             }
         finally:
             d.stop()
 
-    pipelined = run_mode(max_inflight)
+    router = make_router() if chips > 1 else None
+    pipelined = run_mode(max_inflight, router)
+    one_chip = run_mode(max_inflight) if chips > 1 else None
     serial = run_mode(1)
     identical = all(
         leaves_identical(a, b)
         for a, b in zip(pipelined["parity"], serial["parity"])
     )
+    chip_note = ""
+    if chips > 1:
+        base_fps = one_chip["fps"] or 1e-9
+        chip_note = (
+            f"chips={chips}({dispatch_mode}) "
+            f"1chip={one_chip['fps']:.1f}fps "
+            f"scaling={pipelined['fps'] / base_fps:.2f}x "
+            f"balance={pipelined['chip_frames']} "
+        )
     print(
         f"# backend={jax.default_backend()} "
         f"pipelined={pipelined['fps']:.1f}fps "
         f"(overlap={pipelined['overlap_s']:.3f}s "
         f"high_water={pipelined['high_water']}) "
+        f"{chip_note}"
         f"serial={serial['fps']:.1f}fps "
         f"(overlap={serial['overlap_s']:.3f}s) identical={identical}",
         file=sys.stderr,
     )
-    _emit_result({
+    payload = {
         "metric": "serving_pipeline_fps",
         "backend": jax.default_backend(),
         "value": round(pipelined["fps"], 2),
@@ -517,7 +578,27 @@ def serving_pipeline_main(smoke: bool = False) -> None:
         "streams": streams,
         "frames_per_stream": frames_per_stream,
         "smoke": smoke,
-    })
+    }
+    if chips > 1:
+        wall = pipelined["wall"] or 1e-9
+        base_fps = one_chip["fps"]
+        payload.update({
+            "chips": chips,
+            "dispatch_mode": dispatch_mode,
+            "fps_1chip_pipelined": round(base_fps, 2),
+            "scaling_vs_1chip": (round(pipelined["fps"] / base_fps, 3)
+                                 if base_fps else 0.0),
+            "scaling_efficiency": (round(
+                pipelined["fps"] / base_fps / chips, 3) if base_fps
+                else 0.0),
+            "per_chip_fps": {
+                str(i): round(n / wall, 2)
+                for i, n in enumerate(pipelined["chip_frames"])
+            },
+            "chip_frames": pipelined["chip_frames"],
+            "chip_dispatches": pipelined["chip_dispatches"],
+        })
+    _emit_result(payload)
 
 
 if __name__ == "__main__":
@@ -534,10 +615,33 @@ if __name__ == "__main__":
         "--smoke", action="store_true",
         help="CPU-runnable smoke variant of --serving-pipeline",
     )
+    parser.add_argument(
+        "--chips", type=int, default=1,
+        help="route the pipelined serving bench across N mesh chips "
+             "(serving/batching.DeviceRouter); with --smoke the devices "
+             "are faked CPU devices "
+             "(XLA_FLAGS=--xla_force_host_platform_device_count)",
+    )
+    parser.add_argument(
+        "--dispatch-mode", default="round_robin",
+        choices=["round_robin", "sharded"],
+        help="how --chips routes dispatches: whole buckets round-robined "
+             "onto the least-loaded chip, or each bucket sharded over the "
+             "mesh 'data' axis",
+    )
     cli = parser.parse_args()
     _metric = ("serving_pipeline_fps" if cli.serving_pipeline
                else _HEADLINE_METRIC)
     _arm_deadline(_metric)
+    if cli.serving_pipeline and cli.smoke and cli.chips > 1:
+        # the smoke multi-chip path runs on faked CPU devices: pin the
+        # platform and force enough virtual devices BEFORE backend init
+        # (honors an already-exported XLA_FLAGS count when it is enough)
+        from robotic_discovery_platform_tpu.utils.platforms import (
+            force_cpu_platform,
+        )
+
+        force_cpu_platform(min_devices=max(8, cli.chips))
     try:
         _probe_backend()
     except Exception as e:  # noqa: BLE001 -- any probe failure is terminal
@@ -547,7 +651,8 @@ if __name__ == "__main__":
         sys.exit(0)
     try:
         if cli.serving_pipeline:
-            serving_pipeline_main(smoke=cli.smoke)
+            serving_pipeline_main(smoke=cli.smoke, chips=cli.chips,
+                                  dispatch_mode=cli.dispatch_mode)
         else:
             main()
     except Exception as e:  # noqa: BLE001 -- structured artifact by design

@@ -67,6 +67,36 @@ BATCH_STAGE_LATENCY = REGISTRY.histogram(
     "launch (async jit dispatch), complete (blocking D2H + fan-out).",
     ("stage",),
 )
+SERVING_CHIPS = REGISTRY.gauge(
+    "rdp_serving_chips",
+    "Mesh chips the batch dispatcher routes dispatches across (1 = "
+    "single-device dispatch).",
+)
+CHIP_DISPATCHES = REGISTRY.counter(
+    "rdp_chip_dispatches_total",
+    "Batched dispatches launched, by mesh chip (chip '0' covers the "
+    "single-device and data-sharded windows); the per-chip counts sum "
+    "to the dispatcher's total.",
+    ("chip",),
+)
+CHIP_FRAMES = REGISTRY.counter(
+    "rdp_chip_frames_total",
+    "Frames carried by launched dispatches, by mesh chip (padding rows "
+    "excluded).",
+    ("chip",),
+)
+CHIP_INFLIGHT = REGISTRY.gauge(
+    "rdp_chip_inflight_dispatches",
+    "Launched-but-not-completed dispatches per mesh chip; each chip's "
+    "window is independently bounded by max_inflight_dispatches.",
+    ("chip",),
+)
+BATCH_POOL_SIZE = REGISTRY.gauge(
+    "rdp_batch_pool_size",
+    "Free pooled host staging buffer sets across all bucket keys "
+    "(capped per key at max_inflight * chips + 1; sustained growth "
+    "here means a leak).",
+)
 
 # -- resilience --------------------------------------------------------------
 

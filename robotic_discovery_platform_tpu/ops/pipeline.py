@@ -65,10 +65,33 @@ def stage_batch(frames_rgb, depths, intrinsics, depth_scales, device=None):
     device arrays (jit treats both identically; the ``b == 1`` fast path
     in ``_analyze_batch`` is unaffected by where the arrays live).
 
+    ``device`` selects the placement the mesh router threads through here:
+
+    - ``None`` -- the process default device (single-chip serving);
+    - a ``jax.Device`` -- commit the whole batch to ONE mesh chip (the
+      round-robin dispatch mode: each launched bucket lands on the
+      router's least-loaded chip);
+    - a ``Sharding`` (``parallel.mesh.batch_sharding``) -- split the
+      batch's leading dim over the mesh "data" axis (the data-sharded
+      dispatch mode). ``jax.device_put`` performs the per-shard H2D
+      transfers itself, reading each chip's rows straight out of the
+      pooled host staging buffer -- no intermediate per-shard copies.
+
     Returns ``(frames, depths, intrinsics, depth_scales)`` as device
     arrays. ``jax.device_put`` is itself asynchronous, so staging batch
     N+1 overlaps batch N's compute.
     """
+    from jax.sharding import NamedSharding
+
+    if isinstance(device, NamedSharding):
+        b = int(np.shape(frames_rgb)[0])
+        shards = device.mesh.shape.get("data", 1)
+        if b % shards:
+            raise ValueError(
+                f"batch of {b} cannot shard evenly over {shards} 'data' "
+                "chips; the dispatcher pads buckets to a multiple of the "
+                "mesh size before staging"
+            )
     return jax.device_put(
         (frames_rgb, depths, intrinsics, depth_scales), device
     )

@@ -67,6 +67,50 @@ def make_mesh(cfg: MeshConfig = MeshConfig(), devices=None) -> Mesh:
     return Mesh(arr, AXES)
 
 
+def make_serving_mesh(chips: int = 0, devices=None) -> Mesh:
+    """The serving router's mesh: ``chips`` devices along the "data" axis
+    (spatial = model = 1). ``chips`` <= 0 takes every available device.
+    Serving parallelism is pure data parallelism -- each dispatch is an
+    independent padded batch -- so the serving mesh never needs the
+    spatial/model axes the training mesh carries."""
+    devices = list(jax.devices() if devices is None else devices)
+    if chips > 0:
+        if chips > len(devices):
+            raise ValueError(
+                f"serving mesh wants {chips} chips but only "
+                f"{len(devices)} devices are available"
+            )
+        devices = devices[:chips]
+    return make_mesh(MeshConfig(data=len(devices)), devices)
+
+
+def device_ring(mesh: Mesh) -> tuple:
+    """The mesh's devices flattened in data-major order: the ring the
+    serving router round-robins dispatches over."""
+    return tuple(mesh.devices.reshape(-1))
+
+
+def chip_shardings(mesh: Mesh) -> tuple:
+    """One single-device sharding per ring position: the placement a
+    round-robin dispatch uses for its per-chip ``device_put``."""
+    from jax.sharding import SingleDeviceSharding
+
+    return tuple(SingleDeviceSharding(d) for d in device_ring(mesh))
+
+
+def least_loaded(loads, start: int = 0) -> int:
+    """Index of the minimum of ``loads``, ties broken in ring order from
+    ``start``: with all chips idle consecutive picks walk the ring
+    (round-robin), under skewed load the emptiest chip wins."""
+    n = len(loads)
+    best = start % n
+    for off in range(1, n):
+        i = (start + off) % n
+        if loads[i] < loads[best]:
+            best = i
+    return best
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

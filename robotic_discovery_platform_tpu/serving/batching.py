@@ -33,6 +33,28 @@ eliminate):
    events. Padding frames are replicas of the first frame and their
    results are dropped.
 
+Multi-chip routing (:class:`DeviceRouter`, over a ``parallel/mesh``
+"data"-axis mesh): without a router every dispatch lands on ONE chip and
+the rest of the mesh idles. A router spreads the in-flight window across
+the mesh in one of two modes:
+
+- **round_robin** -- each launched bucket is staged whole
+  (``ops/pipeline.stage_batch`` with a per-chip ``device_put``) onto the
+  least-loaded chip (ties walk the ring), giving N independent in-flight
+  windows of ``max_inflight`` each; the ONE shared completer still drains
+  in global launch order, so per-stream result order is unchanged.
+  Aggregate FPS scales with chips for single-frame buckets.
+- **sharded** -- one large padded bucket is placed with
+  ``NamedSharding(P("data"))`` so a single dispatch splits over the mesh
+  "data" axis (per-shard H2D straight from the pooled staging buffers);
+  the in-flight window stays global.
+
+``max_inflight=1`` on a single-device mesh (or no router at all) is the
+serial mode: bit-identical results, no overlap. A dead stage's watchdog
+recovery and ``stop()``'s drain guarantees hold per chip -- the window
+reset rebuilds EVERY chip's semaphore, and pooled buffers ride their
+dispatch regardless of which chip ran it.
+
 Resilience (resilience/ package):
 
 - the queue is *bounded*: a submit arriving with ``max_backlog`` frames
@@ -83,12 +105,17 @@ from robotic_discovery_platform_tpu.observability import (
     trace,
 )
 from robotic_discovery_platform_tpu.ops import pipeline as pipeline_lib
+from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
 from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 _INFLIGHT_ENV_VAR = "RDP_INFLIGHT"
+_CHIPS_ENV_VAR = "RDP_SERVING_CHIPS"
+_MODE_ENV_VAR = "RDP_DISPATCH_MODE"
+
+DISPATCH_MODES = ("round_robin", "sharded")
 
 
 def resolve_max_inflight(configured: int) -> int:
@@ -97,6 +124,74 @@ def resolve_max_inflight(configured: int) -> int:
     raw = os.environ.get(_INFLIGHT_ENV_VAR)
     value = int(raw) if raw else int(configured)
     return max(1, value)
+
+
+def resolve_serving_chips(configured: int) -> int:
+    """The effective serving-mesh chip count: ``RDP_SERVING_CHIPS`` when
+    set, else ``ServerConfig.serving_mesh``. Negative = every available
+    device (resolved at mesh build, not here); 0 clamps to 1 (single-chip
+    dispatch, exactly the router-less behavior)."""
+    raw = os.environ.get(_CHIPS_ENV_VAR)
+    value = int(raw) if raw else int(configured)
+    if value < 0:
+        return len(jax.devices())
+    return max(1, value)
+
+
+def resolve_dispatch_mode(configured: str) -> str:
+    """The effective dispatch mode: ``RDP_DISPATCH_MODE`` when set, else
+    ``ServerConfig.dispatch_mode``; dashes normalize to underscores."""
+    mode = (os.environ.get(_MODE_ENV_VAR) or configured).replace("-", "_")
+    if mode not in DISPATCH_MODES:
+        raise ValueError(
+            f"unknown dispatch mode {mode!r}; expected one of "
+            f"{DISPATCH_MODES}"
+        )
+    return mode
+
+
+class DeviceRouter:
+    """Placement policy for the dispatcher's in-flight window over a
+    serving mesh (``parallel.mesh.make_serving_mesh``).
+
+    Args:
+        mesh: a Mesh whose data-major device ring the router spreads
+            dispatches over (serving only uses the "data" axis).
+        mode: "round_robin" (whole buckets onto the least-loaded chip) or
+            "sharded" (each bucket split over the "data" axis).
+        analyzers: optional per-chip analyzer callables, same signature as
+            ``BatchDispatcher``'s ``analyze_batch``. The serving layer
+            passes closures over per-chip replicated model variables here
+            (round_robin: one per ring position; sharded: a single entry
+            closed over mesh-replicated variables) -- without them the
+            dispatcher's shared analyzer is used on every chip, which is
+            correct but re-transfers uncommitted weights per dispatch.
+    """
+
+    def __init__(self, mesh, mode: str = "round_robin", analyzers=None):
+        if mode not in DISPATCH_MODES:
+            raise ValueError(
+                f"unknown dispatch mode {mode!r}; expected one of "
+                f"{DISPATCH_MODES}"
+            )
+        self.mesh = mesh
+        self.mode = mode
+        self.ring = mesh_lib.device_ring(mesh)
+        self.analyzers = list(analyzers) if analyzers is not None else None
+        if self.analyzers is not None:
+            expected = 1 if mode == "sharded" else len(self.ring)
+            if len(self.analyzers) != expected:
+                raise ValueError(
+                    f"{mode} router over {len(self.ring)} chips expected "
+                    f"{expected} analyzer(s), got {len(self.analyzers)}"
+                )
+        self.sharding = (
+            mesh_lib.batch_sharding(mesh) if mode == "sharded" else None
+        )
+
+    @property
+    def chips(self) -> int:
+        return len(self.ring)
 
 
 class OverloadedError(RuntimeError):
@@ -151,6 +246,9 @@ class _Dispatch:
     # a fresh semaphore.
     slot: threading.Semaphore
     launch_t: float
+    # which routed chip (ring index) launched this dispatch; 0 for the
+    # single-device and data-sharded windows
+    chip: int = 0
 
 
 def _bucket(n: int, max_batch: int) -> int:
@@ -184,35 +282,78 @@ class BatchDispatcher:
             be launched but not yet completed at once. 1 = serial (launch
             N+1 only after N's results are on the host); 2 (default)
             overlaps batch N+1's staging/compute with batch N's D2H.
+            Under a round_robin router the cap is PER CHIP (N independent
+            windows); under a sharded router (and without a router) it is
+            the one global window.
+        router: optional :class:`DeviceRouter` spreading dispatches across
+            a serving mesh. None (default) keeps today's single-device
+            dispatch exactly.
     """
 
     def __init__(self, analyze_batch: Callable, window_ms: float = 2.0,
                  max_batch: int = 8, max_backlog: int = 64,
                  submit_timeout_s: float = 30.0,
                  watchdog_interval_s: float = 1.0,
-                 max_inflight: int = 2):
+                 max_inflight: int = 2,
+                 router: DeviceRouter | None = None):
         self._analyze = analyze_batch
         self._window_s = window_ms / 1e3
         self._max_batch = max_batch
         self._max_backlog = max_backlog
         self._submit_timeout_s = submit_timeout_s
         self._max_inflight = max(1, int(max_inflight))
+        self._router = router
+        if router is not None and router.mode == "sharded":
+            chips = router.chips
+            if chips & (chips - 1):
+                raise ValueError(
+                    f"sharded dispatch needs a power-of-two chip count "
+                    f"(buckets are powers of two); got {chips}"
+                )
+            if max_batch < chips or max_batch % chips:
+                raise ValueError(
+                    f"sharded dispatch over {chips} chips needs max_batch "
+                    f"to be a multiple of the chip count; got {max_batch}"
+                )
+        # the independent launch windows: one per ring chip under a
+        # round_robin router, otherwise the single global window (the
+        # sharded mode's one dispatch already spans every chip)
+        if router is not None and router.mode == "round_robin":
+            self._n_windows = router.chips
+        else:
+            self._n_windows = 1
         self._q: queue.Queue[_Pending | None] = queue.Queue()
         self._cq: queue.Queue[_Dispatch | None] = queue.Queue()
-        self._inflight = threading.Semaphore(self._max_inflight)
+        self._chip_slots = [
+            threading.Semaphore(self._max_inflight)
+            for _ in range(self._n_windows)
+        ]
         self._inflight_lock = threading.Lock()
         self._inflight_count = 0
+        self._chip_inflight = [0] * self._n_windows
+        self._rr_next = 0  # least-loaded tie-break cursor (ring order)
+        #: per-chip launched-dispatch / carried-frame totals (padding rows
+        #: excluded); the bench derives per-chip FPS and balance from these
+        self.chip_dispatches = [0] * self._n_windows
+        self.chip_frames = [0] * self._n_windows
+        self.chip_inflight_high_water = [0] * self._n_windows
         #: high-water mark of concurrently in-flight dispatches; never
-        #: exceeds ``max_inflight`` (tests and the bench assert on this)
+        #: exceeds ``max_inflight`` per window (tests and the bench assert
+        #: on this)
         self.inflight_high_water = 0
         #: total seconds completed dispatches overlapped the next launch
         #: (0.0 in serial mode); written only by the completer thread
         self.overlap_s_total = 0.0
         self._last_done_t = 0.0
         # pooled host staging buffers, keyed by (bucket, frame shape/dtype,
-        # depth dtype); free-list only -- buffers in use ride the dispatch
+        # depth dtype); free-list only -- buffers in use ride the dispatch.
+        # Capped per key at one buffer set per possible in-flight dispatch
+        # plus the one being staged: anything beyond that is a leak, so
+        # _pool_put drops extras instead of growing without bound.
         self._pool: dict[tuple, list[_BucketBuffers]] = {}
+        self._pool_cap = self._max_inflight * self._n_windows + 1
         self._pool_lock = threading.Lock()
+        obs.SERVING_CHIPS.set(router.chips if router is not None else 1)
         self._stopped = threading.Event()
         self._submit_lock = threading.Lock()
         # every not-yet-completed submit, whether still queued, staged, or
@@ -384,14 +525,20 @@ class BatchDispatcher:
                         break
                     if d is not None:
                         self._pool_put(d.bufs)
-                # fresh in-flight window: slots held by dispatches lost
-                # with the dead stage can never be released (a dispatch
-                # still riding a live completer releases its OWN slot
-                # object, never this new one)
-                self._inflight = threading.Semaphore(self._max_inflight)
+                # fresh in-flight windows ON EVERY CHIP: slots held by
+                # dispatches lost with the dead stage can never be
+                # released (a dispatch still riding a live completer
+                # releases its OWN slot object, never these new ones)
+                self._chip_slots = [
+                    threading.Semaphore(self._max_inflight)
+                    for _ in range(self._n_windows)
+                ]
                 with self._inflight_lock:
                     self._inflight_count = 0
+                    self._chip_inflight = [0] * self._n_windows
                     obs.INFLIGHT_DISPATCHES.set(0)
+                    for chip in range(self._n_windows):
+                        obs.CHIP_INFLIGHT.labels(chip=str(chip)).set(0)
                 self._fail_pending(RuntimeError(
                     f"batch {dead} died; frame dropped"
                 ))
@@ -441,14 +588,74 @@ class BatchDispatcher:
         with self._pool_lock:
             free = self._pool.get(key)
             if free:
-                return free.pop()
+                bufs = free.pop()
+                obs.BATCH_POOL_SIZE.set(
+                    sum(len(v) for v in self._pool.values())
+                )
+                return bufs
         return _BucketBuffers(key, template, key[0])
 
     def _pool_put(self, bufs: _BucketBuffers | None) -> None:
         if bufs is None:
             return
         with self._pool_lock:
-            self._pool.setdefault(bufs.key, []).append(bufs)
+            free = self._pool.setdefault(bufs.key, [])
+            # capped free list: at most one buffer set per possible
+            # in-flight dispatch plus the one being staged can ever be
+            # legitimately out at once, so a longer free list is growth
+            # from a leak path (e.g. repeated watchdog drains) -- drop the
+            # extra and let the gauge make any further growth visible
+            if len(free) < self._pool_cap:
+                free.append(bufs)
+            obs.BATCH_POOL_SIZE.set(sum(len(v) for v in self._pool.values()))
+
+    # -- mesh routing --------------------------------------------------------
+
+    def _pick_chip(self) -> int:
+        """The ring index the next dispatch launches on: the least-loaded
+        chip by current in-flight count, ties walking the ring from the
+        cursor (so an idle mesh round-robins and a skewed one heals)."""
+        if self._n_windows == 1:
+            return 0
+        with self._inflight_lock:
+            chip = mesh_lib.least_loaded(self._chip_inflight, self._rr_next)
+            self._rr_next = (chip + 1) % self._n_windows
+        return chip
+
+    def _placement(self, chip: int):
+        """What ``stage_batch`` should place this dispatch with: the routed
+        chip's device, the mesh-wide data sharding, or None (default
+        device, router-less -- today's behavior exactly)."""
+        if self._router is None:
+            return None
+        if self._router.mode == "sharded":
+            return self._router.sharding
+        return self._router.ring[chip]
+
+    def _analyze_for(self, chip: int) -> Callable:
+        a = self._router.analyzers if self._router is not None else None
+        return a[min(chip, len(a) - 1)] if a else self._analyze
+
+    def bucket_for(self, n: int) -> int:
+        """The padded bucket a group of ``n`` frames dispatches as. Sharded
+        routing raises the floor to the chip count so every chip gets at
+        least one row (the constructor validated divisibility)."""
+        b = _bucket(n, self._max_batch)
+        if self._router is not None and self._router.mode == "sharded":
+            b = min(max(b, self._router.chips), self._max_batch)
+        return b
+
+    def warm(self, frames, depths, intrinsics, scales) -> None:
+        """Compile + run the analyzer for this batch shape on EVERY routed
+        placement, blocking until done: warm-up and hot-reload
+        pre-compilation route through here so the first real frame on any
+        chip (or under the sharded layout) never pays XLA compilation."""
+        for chip in range(self._n_windows):
+            staged = pipeline_lib.stage_batch(
+                frames, depths, intrinsics, scales,
+                device=self._placement(chip),
+            )
+            jax.block_until_ready(self._analyze_for(chip)(*staged))
 
     def _stage_group(self, group: list[_Pending], b: int):
         """Host-side staging: the padded [b, ...] batch arrays for a group.
@@ -482,11 +689,15 @@ class BatchDispatcher:
         return bufs, bufs.frames, bufs.depths, bufs.intr, bufs.scales
 
     def _launch_group(self, group: list[_Pending]) -> None:
-        """Stage + H2D + async launch of one geometry group, then hand the
-        in-flight dispatch to the completer. Never blocks on the result."""
-        # bounded in-flight window: dispatch N+1 may not launch until a
-        # slot frees (i.e. at most max_inflight batches hold device memory)
-        slot = self._inflight
+        """Stage + H2D + async launch of one geometry group onto the routed
+        chip, then hand the in-flight dispatch to the completer. Never
+        blocks on the result."""
+        # bounded in-flight window, per routed chip: dispatch N+1 on a chip
+        # may not launch until one of THAT chip's slots frees (at most
+        # max_inflight batches hold each chip's device memory). The pick is
+        # least-loaded, so blocking here means every chip's window is full.
+        chip = self._pick_chip()
+        slot = self._chip_slots[chip]
         while not slot.acquire(timeout=0.05):
             if self._stopped.is_set():
                 self._fail_group(
@@ -499,13 +710,15 @@ class BatchDispatcher:
             inject("serving.batch.dispatch")
             n = len(group)
             obs.BATCH_SIZE.observe(n)
-            b = _bucket(n, self._max_batch)
+            b = self.bucket_for(n)
             t0 = time.monotonic()
             bufs, frames, depths, intr, scales = self._stage_group(group, b)
-            staged = pipeline_lib.stage_batch(frames, depths, intr, scales)
+            staged = pipeline_lib.stage_batch(
+                frames, depths, intr, scales, device=self._placement(chip)
+            )
             t1 = time.monotonic()
             # jit async dispatch: returns once the computation is enqueued
-            out = self._analyze(*staged)
+            out = self._analyze_for(chip)(*staged)
             t2 = time.monotonic()
             obs.BATCH_STAGE_LATENCY.labels(stage="stage").observe(t1 - t0)
             obs.BATCH_STAGE_LATENCY.labels(stage="launch").observe(t2 - t1)
@@ -514,8 +727,20 @@ class BatchDispatcher:
                 self.inflight_high_water = max(
                     self.inflight_high_water, self._inflight_count
                 )
+                self._chip_inflight[chip] += 1
+                self.chip_inflight_high_water[chip] = max(
+                    self.chip_inflight_high_water[chip],
+                    self._chip_inflight[chip],
+                )
+                self.chip_dispatches[chip] += 1
+                self.chip_frames[chip] += n
                 obs.INFLIGHT_DISPATCHES.set(self._inflight_count)
-            self._cq.put(_Dispatch(group, out, bufs, slot, t2))
+                obs.CHIP_INFLIGHT.labels(chip=str(chip)).set(
+                    self._chip_inflight[chip]
+                )
+            obs.CHIP_DISPATCHES.labels(chip=str(chip)).inc()
+            obs.CHIP_FRAMES.labels(chip=str(chip)).inc(n)
+            self._cq.put(_Dispatch(group, out, bufs, slot, t2, chip))
             launched = True
         except BaseException as exc:  # deliver, don't kill the collector
             self._fail_group(group, exc)
@@ -560,6 +785,13 @@ class BatchDispatcher:
                 with self._inflight_lock:
                     self._inflight_count = max(0, self._inflight_count - 1)
                     obs.INFLIGHT_DISPATCHES.set(self._inflight_count)
+                    if d.chip < self._n_windows:
+                        self._chip_inflight[d.chip] = max(
+                            0, self._chip_inflight[d.chip] - 1
+                        )
+                        obs.CHIP_INFLIGHT.labels(chip=str(d.chip)).set(
+                            self._chip_inflight[d.chip]
+                        )
                 d.slot.release()
 
     def _fail_group(self, group: list[_Pending], exc: BaseException,

@@ -75,7 +75,11 @@ from robotic_discovery_platform_tpu.resilience import (
     inject,
 )
 from robotic_discovery_platform_tpu.serving import health as health_lib
-from robotic_discovery_platform_tpu.serving.batching import OverloadedError
+from robotic_discovery_platform_tpu.serving.batching import (
+    OverloadedError,
+    resolve_dispatch_mode,
+    resolve_serving_chips,
+)
 from robotic_discovery_platform_tpu.serving.metrics import MetricsWriter
 from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
 from robotic_discovery_platform_tpu.utils.config import (
@@ -175,6 +179,25 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # to build here; rebuilding per poll would churn MLflow clients
         # and scratch dirs)
         self._registry_store = tracking.store_for(cfg.tracking_uri)
+        # Serving mesh (multi-chip dispatch): built ONCE at startup and
+        # shared by every engine generation -- hot-reload swaps analyzers
+        # and variables, never devices. Only meaningful when micro-batching
+        # is on (the single-frame path has no dispatch window to route).
+        self.dispatch_mode = resolve_dispatch_mode(cfg.dispatch_mode)
+        self._serving_mesh = None
+        chips = resolve_serving_chips(cfg.serving_mesh)
+        if cfg.batch_window_ms > 0 and chips > 1:
+            from robotic_discovery_platform_tpu.parallel import (
+                mesh as mesh_lib,
+            )
+
+            self._serving_mesh = mesh_lib.make_serving_mesh(chips)
+            log.info(
+                "serving mesh: %d chip(s), %s dispatch",
+                chips, self.dispatch_mode,
+            )
+        #: devices the batch dispatcher routes across (1 = single-device)
+        self.serving_chips = chips if self._serving_mesh is not None else 1
         self._engine = self._make_engine(model, variables, version)
         self._warm_shape: tuple[int, int] | None = None
         self._reload_stop: threading.Event | None = None
@@ -203,6 +226,12 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # begins.
         self.health = health_lib.HealthServicer()
         self.health.set(vision_grpc.SERVICE_NAME, health_lib.NOT_SERVING)
+        # one readiness entry per routed chip: a probe can enumerate
+        # rdp.serving.chip.<i> until NOT_FOUND to read the mesh width;
+        # the entries flip with overall readiness (set_all)
+        for i in range(self.serving_chips):
+            self.health.set(f"rdp.serving.chip.{i}", health_lib.NOT_SERVING)
+        obs.SERVING_CHIPS.set(self.serving_chips)
         # in-flight stream accounting for graceful drain
         self._streams_cond = threading.Condition()
         self._active_streams = 0
@@ -232,7 +261,19 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
     def _make_engine(self, model, variables, version) -> Engine:
         cfg, geom_cfg = self.cfg, self.geom_cfg
-        forward = self._build_forward(model, variables, cfg)
+        if self._serving_mesh is not None:
+            # the Pallas-fused forward closes over default-device buffers
+            # and has no partitioning rules, so under a serving mesh every
+            # chip runs the Flax/XLA forward (the trainer applies the same
+            # policy under its mesh)
+            if cfg.model_forward == "pallas":
+                log.warning(
+                    "model_forward='pallas' cannot route across a serving "
+                    "mesh; using the Flax/XLA forward on every chip"
+                )
+            forward = None
+        else:
+            forward = self._build_forward(model, variables, cfg)
         analyze = pipeline.make_frame_analyzer(
             model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
             forward=forward,
@@ -241,6 +282,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         if cfg.batch_window_ms > 0:
             from robotic_discovery_platform_tpu.serving.batching import (
                 BatchDispatcher,
+                DeviceRouter,
                 resolve_max_inflight,
             )
 
@@ -254,6 +296,38 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
                 forward=forward,
             )
+            router = None
+            if self._serving_mesh is not None:
+                from robotic_discovery_platform_tpu.parallel import (
+                    mesh as mesh_lib,
+                )
+
+                # bind the model weights to each placement ONCE per engine
+                # generation: per-chip replicas (round_robin) or one
+                # mesh-replicated copy (sharded). Passing uncommitted
+                # variables would re-transfer the whole weight tree on
+                # every routed dispatch.
+                if self.dispatch_mode == "round_robin":
+                    analyzers = [
+                        (lambda frames, depths, intr, scales, _v=v:
+                         batch_analyze(_v, frames, depths, intr, scales))
+                        for v in (
+                            jax.device_put(variables, d)
+                            for d in mesh_lib.device_ring(self._serving_mesh)
+                        )
+                    ]
+                else:
+                    v_repl = mesh_lib.shard_pytree(
+                        self._serving_mesh, variables
+                    )
+                    analyzers = [
+                        lambda frames, depths, intr, scales: batch_analyze(
+                            v_repl, frames, depths, intr, scales
+                        )
+                    ]
+                router = DeviceRouter(
+                    self._serving_mesh, self.dispatch_mode, analyzers
+                )
             dispatcher = BatchDispatcher(
                 lambda frames, depths, intr, scales: batch_analyze(
                     variables, frames, depths, intr, scales
@@ -266,6 +340,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 max_inflight=resolve_max_inflight(
                     cfg.max_inflight_dispatches
                 ),
+                router=router,
             )
         return Engine(analyze, variables, dispatcher, version)
 
@@ -625,17 +700,21 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 np.float32(self.depth_scale),
             )
             return
-        # the dispatcher pads each dispatch to min(next_pow2(n), max_batch),
-        # so the reachable bucket sizes are the powers of two below
-        # max_batch plus max_batch itself (which is the top bucket even
-        # when it is not a power of two)
-        sizes, b = [], 1
+        # the dispatcher pads each dispatch to min(next_pow2(n), max_batch)
+        # -- with a sharded router the floor rises to the chip count -- so
+        # the reachable bucket sizes are bucket_for() over the powers of
+        # two below max_batch plus max_batch itself (the top bucket even
+        # when it is not a power of two). warm() compiles each bucket on
+        # EVERY routed placement, so a load burst's first dispatch to any
+        # chip is already compiled.
+        dispatcher = engine.dispatcher
+        sizes, b = set(), 1
         while b < self.cfg.max_batch:
-            sizes.append(b)
+            sizes.add(dispatcher.bucket_for(b))
             b *= 2
-        sizes.append(self.cfg.max_batch)
-        for b in sizes:
-            engine.dispatcher._analyze(
+        sizes.add(dispatcher.bucket_for(self.cfg.max_batch))
+        for b in sorted(sizes):
+            dispatcher.warm(
                 np.zeros((b, h, w, 3), np.uint8),
                 np.zeros((b, h, w), np.uint16),
                 np.repeat(np.asarray(k, np.float32)[None], b, 0),

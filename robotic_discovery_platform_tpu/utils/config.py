@@ -198,6 +198,23 @@ class ServerConfig:
     # pressure, not throughput, unless completion (D2H + fan-out) is the
     # bottleneck. The RDP_INFLIGHT env var overrides this value.
     max_inflight_dispatches: int = 2
+    # Multi-chip serving (serving/batching.DeviceRouter over a
+    # parallel/mesh "data"-axis mesh): how many devices the dispatcher
+    # routes its in-flight window across. 0/1 (default) = single-device
+    # dispatch, exactly today's behavior; N > 1 takes the first N devices;
+    # -1 takes every available device. Only meaningful when micro-batching
+    # is on (batch_window_ms > 0). The RDP_SERVING_CHIPS env var overrides
+    # this value.
+    serving_mesh: int = 0
+    # How a routed dispatch uses the mesh: "round_robin" stages each
+    # launched bucket whole onto the least-loaded chip (N independent
+    # in-flight windows, one shared completer draining in global launch
+    # order -- aggregate FPS scales with chips for single-frame buckets);
+    # "sharded" splits one large padded bucket over the mesh "data" axis
+    # (NamedSharding(P("data")), per-shard H2D from the pooled staging
+    # buffers -- best when single batches are big enough to fill every
+    # chip). The RDP_DISPATCH_MODE env var overrides this value.
+    dispatch_mode: str = "round_robin"
     # Geometry decimation stride (GeometryConfig.stride). 1 = reference-
     # exact dense semantics, the DEFAULT: serving numerics match the
     # reference out of the box. 2 is the opt-in fast profile -- it quarters

@@ -245,6 +245,70 @@ def test_batched_results_match_single_frame(batching_server, registered_model,
     )
 
 
+def test_multichip_batching_server_routes_and_exposes_chips(
+        registered_model, tmp_path):
+    """A server with ServerConfig.serving_mesh=4 builds the serving mesh at
+    startup, routes the dispatcher across it, registers one health entry
+    per chip (probes can enumerate the mesh width), and serves concurrent
+    streams correctly end to end."""
+    import threading
+
+    from robotic_discovery_platform_tpu.serving import health as health_lib
+
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=registered_model,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        metrics_flush_every=1,
+        calibration_path=str(tmp_path / "missing.npz"),
+        batch_window_ms=10.0,
+        max_batch=4,
+        serving_mesh=4,
+        reload_poll_s=0,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        assert servicer.serving_chips == 4
+        assert servicer.dispatch_mode == "round_robin"
+        router = servicer.dispatcher._router
+        assert router is not None and router.chips == 4
+        # one readiness entry per routed chip, flipped with mark_ready()
+        for i in range(4):
+            assert (servicer.health.get(f"rdp.serving.chip.{i}")
+                    == health_lib.SERVING)
+        assert servicer.health.get("rdp.serving.chip.4") is None
+        results = {}
+
+        def one_stream(seed):
+            source = SyntheticSource(width=160, height=120, seed=seed,
+                                     n_frames=4)
+            results[seed] = client_lib.run_client(
+                ClientConfig(server_address=f"localhost:{port}",
+                             calibration_path="none.npz"),
+                source=source, max_frames=4,
+            )
+
+        threads = [threading.Thread(target=one_stream, args=(s,))
+                   for s in (1, 2, 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert set(results) == {1, 2, 3}
+        for rs in results.values():
+            assert len(rs) == 4
+            for r in rs:
+                assert r.status.startswith(("OK", "DEGRADED"))
+        # the mesh actually carried the dispatches
+        d = servicer.dispatcher
+        assert sum(d.chip_frames) == 12
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+
+
 def test_dispatcher_delivers_failures_and_survives():
     """A failing batched analysis reaches every waiting caller as an
     exception and the collector thread keeps serving later batches."""
