@@ -1,0 +1,413 @@
+"""Open-loop tail-latency harness for the live gRPC analysis server.
+
+``bench.py`` measures CLOSED-loop throughput: every stream waits for its
+previous frame before sending the next, so the server never sees more
+load than it can absorb and queueing delay is invisible. Production
+serving is judged the other way around -- requests arrive whether or not
+the server is keeping up (InferLine's SLO-driven planning and Clockwork's
+predictable-tail argument, PAPERS.md) -- so this harness generates
+**open-loop** arrivals (Poisson, or a replayed inter-arrival trace)
+against the live server and reports what the tail actually looks like:
+
+- p50 / p95 / p99 / p99.9 latency per offered-load level, measured from
+  each request's *scheduled* arrival time (queueing delay counts;
+  no coordinated omission);
+- SLO violation rate against ``--slo-ms`` (errors and sheds count as
+  violations -- a failed frame never met its objective);
+- goodput (ok responses/sec) vs offered load.
+
+Results go to ``LOADBENCH.json`` (one row per offered-load level) and the
+driver contract from bench.py holds: exactly ONE JSON summary line on
+stdout, structured errors instead of tracebacks.
+
+Usage:
+    python bench_load.py --smoke                # self-hosted CPU server
+    python bench_load.py --server host:50051 --loads 50,100,200
+    python bench_load.py --smoke --trace gaps.json   # replay (ms gaps)
+
+``--smoke`` boots an in-process CPU server (tiny model, 64x64 frames,
+micro-batching on so the flight recorder and the ``serving.batch.*``
+fault sites are exercised) and is what CI's ``load-smoke`` job runs --
+including under ``RDP_FAULTS=serving.batch.complete:exc:1``, where the
+injected D2H failure must surface as counted violations, never a crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+
+#: (percentile, row key) for every reported quantile
+PERCENTILES = ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms"),
+               (99.9, "p999_ms"))
+
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "1200"))
+
+_result_printed = False
+_EMIT_LOCK = threading.Lock()
+
+
+def _emit_result(payload: dict) -> None:
+    global _result_printed
+    with _EMIT_LOCK:
+        if _result_printed:
+            return
+        print(json.dumps(payload), flush=True)
+        _result_printed = True
+
+
+def _error_payload(kind: str, detail: str) -> dict:
+    return {
+        "metric": "open_loop_tail_latency",
+        "value": 0.0,
+        "unit": "ms",
+        "error": kind,
+        "detail": detail[-800:],
+    }
+
+
+def _arm_deadline() -> None:
+    def fire() -> None:
+        _emit_result(_error_payload(
+            "bench_deadline_exceeded",
+            f"no result after {DEADLINE_S:.0f}s",
+        ))
+        os._exit(0)
+
+    t = threading.Timer(DEADLINE_S, fire)
+    t.daemon = True
+    t.start()
+
+
+# -- arrival processes -------------------------------------------------------
+
+
+def poisson_arrivals(rate_hz: float, duration_s: float,
+                     rng: np.random.Generator) -> list[float]:
+    """Arrival offsets (seconds from window start) of a Poisson process:
+    exponential inter-arrival gaps at ``rate_hz``."""
+    out: list[float] = []
+    t = float(rng.exponential(1.0 / rate_hz))
+    while t < duration_s:
+        out.append(t)
+        t += float(rng.exponential(1.0 / rate_hz))
+    return out
+
+
+def trace_arrivals(path: str) -> list[float]:
+    """Replayed arrivals from a JSON array of inter-arrival gaps in
+    MILLISECONDS (the shape a production access log reduces to)."""
+    gaps_ms = json.loads(Path(path).read_text())
+    if not isinstance(gaps_ms, list) or not gaps_ms:
+        raise ValueError(f"{path}: expected a non-empty JSON array of "
+                         "inter-arrival milliseconds")
+    out, t = [], 0.0
+    for g in gaps_ms:
+        t += float(g) / 1e3
+        out.append(t)
+    return out
+
+
+# -- measurement -------------------------------------------------------------
+
+
+def summarize_level(lat_ms: list[float], errors: int, offered_rps: float,
+                    wall_s: float, slo_ms: float | None) -> dict:
+    """One LOADBENCH.json row: tail percentiles + violation rate +
+    goodput for one offered-load level."""
+    arr = np.asarray(sorted(lat_ms), dtype=float)
+    n_total = int(arr.size) + errors
+    row = {
+        "offered_rps": round(offered_rps, 3),
+        "arrivals": n_total,
+        "n": int(arr.size),
+        "errors": errors,
+        "achieved_rps": round(n_total / wall_s, 3) if wall_s > 0 else 0.0,
+        "goodput_rps": round(arr.size / wall_s, 3) if wall_s > 0 else 0.0,
+        "wall_s": round(wall_s, 3),
+    }
+    for pct, key in PERCENTILES:
+        row[key] = (round(float(np.percentile(arr, pct)), 3)
+                    if arr.size else None)
+    if slo_ms is not None:
+        violations = int(np.count_nonzero(arr > slo_ms)) + errors
+        row["slo_ms"] = slo_ms
+        row["violations"] = violations
+        row["violation_rate"] = (round(violations / n_total, 4)
+                                 if n_total else 0.0)
+    return row
+
+
+def run_level(stub, request, arrivals: list[float],
+              workers: int) -> tuple[list[float], int, float]:
+    """Fire one offered-load level: every arrival opens a one-frame
+    stream at its scheduled time (late workers start late and the delay
+    COUNTS -- latency is measured from the scheduled arrival, the
+    open-loop discipline that makes queueing visible)."""
+    lat_ms: list[float] = []
+    errors = 0
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def one(offset_s: float) -> None:
+        nonlocal errors
+        target = t0 + offset_s
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        ok = False
+        try:
+            status = None
+            for resp in stub.AnalyzeActuatorPerformance(iter([request])):
+                status = resp.status
+            ok = status is not None and not status.startswith("ERROR")
+        except Exception:
+            ok = False
+        done = time.perf_counter()
+        with lock:
+            if ok:
+                lat_ms.append((done - target) * 1e3)
+            else:
+                errors += 1
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        for offset in arrivals:
+            pool.submit(one, offset)
+    wall = time.perf_counter() - t0
+    return lat_ms, errors, wall
+
+
+# -- smoke server ------------------------------------------------------------
+
+
+def boot_smoke_server(slo_ms: float):
+    """An in-process CPU server shaped like tools/metrics_smoke.py's:
+    tiny registered model, micro-batching ON (so the dispatcher, the
+    flight recorder, and the serving.batch.* fault sites are all in the
+    measured path), metrics endpoint on an ephemeral port."""
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        force_cpu_platform,
+    )
+
+    force_cpu_platform(min_devices=1)
+
+    import jax
+
+    from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.serving import server as server_lib
+    from robotic_discovery_platform_tpu.utils.config import (
+        ModelConfig,
+        ServerConfig,
+    )
+
+    tmp = Path(tempfile.mkdtemp(prefix="rdp-load-bench-"))
+    uri = f"file:{tmp}/mlruns"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = init_unet(model, jax.random.key(0), img_size=64)
+    with tracking.start_run():
+        version = tracking.log_model(
+            variables, mcfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", version
+    )
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        model_img_size=64,
+        metrics_csv=str(tmp / "metrics.csv"),
+        metrics_flush_every=64,
+        calibration_path=str(tmp / "missing.npz"),
+        batch_window_ms=2.0,
+        max_batch=4,
+        metrics_port=-1,
+        reload_poll_s=0.0,
+        slo_ms=slo_ms,
+    )
+    # no warmup_shape here on purpose: an armed serving.batch.complete
+    # fault would fire inside build_server's warm-up frame and abort the
+    # boot; the harness's own warm phase absorbs (and counts) it instead
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    return server, servicer, f"localhost:{port}"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="boot an in-process CPU server (tiny model, "
+                             "64x64 frames) and run short levels")
+    parser.add_argument("--server", default=None,
+                        help="address of an already-running server "
+                             "(host:port); mutually exclusive with --smoke")
+    parser.add_argument("--loads", default=None,
+                        help="comma-separated offered loads in frames/sec "
+                             "(default: 5,10,20 smoke / 50,100,200 full)")
+    parser.add_argument("--duration", type=float, default=None,
+                        help="seconds per load level (default: 2.5 smoke "
+                             "/ 20 full)")
+    parser.add_argument("--trace", default=None,
+                        help="replay arrivals from a JSON array of "
+                             "inter-arrival milliseconds instead of "
+                             "Poisson levels")
+    parser.add_argument("--slo-ms", type=float, default=None,
+                        help="client-side latency objective for the "
+                             "violation-rate column (default: RDP_SLO_MS "
+                             "or 250 smoke / 50 full)")
+    parser.add_argument("--workers", type=int, default=32,
+                        help="max concurrent in-flight requests (the "
+                             "simulated client-fleet width)")
+    parser.add_argument("--frame-size", type=int, default=None,
+                        help="square frame edge (default 64 smoke / 480 "
+                             "full; full mode sends 640x480)")
+    parser.add_argument("--out", default="LOADBENCH.json",
+                        help="result file (default LOADBENCH.json)")
+    parser.add_argument("--seed", type=int, default=0)
+    cli = parser.parse_args()
+    if not cli.smoke and not cli.server:
+        parser.error("one of --smoke or --server is required")
+
+    import grpc
+
+    from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+    from robotic_discovery_platform_tpu.serving import client as client_lib
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+
+    env_slo = os.environ.get("RDP_SLO_MS", "").strip()
+    slo_ms = (cli.slo_ms if cli.slo_ms is not None
+              else float(env_slo) if env_slo
+              else (250.0 if cli.smoke else 50.0))
+    loads = ([float(x) for x in cli.loads.split(",")] if cli.loads
+             else ([5.0, 10.0, 20.0] if cli.smoke
+                   else [50.0, 100.0, 200.0]))
+    duration = cli.duration or (2.5 if cli.smoke else 20.0)
+    if cli.frame_size:
+        w = h = cli.frame_size
+    else:
+        w, h = (64, 64) if cli.smoke else (640, 480)
+
+    server = servicer = None
+    if cli.smoke:
+        server, servicer, address = boot_smoke_server(slo_ms)
+    else:
+        address = cli.server
+
+    rng = np.random.default_rng(cli.seed)
+    source = SyntheticSource(width=w, height=h, seed=cli.seed, n_frames=1)
+    source.start()
+    color, depth = source.get_frames()
+    source.stop()
+    request = client_lib.encode_request(color, depth)
+
+    channel = grpc.insecure_channel(address)
+    stub = vision_grpc.VisionAnalysisServiceStub(channel)
+
+    rows: list[dict] = []
+    warm_errors = 0
+    try:
+        # warm phase, off the measured window: pays XLA compilation for
+        # the single-frame bucket and ABSORBS any armed one-shot fault
+        # (CI's graceful-degradation leg) -- errors are counted, not fatal
+        for _ in range(3):
+            try:
+                resps = list(stub.AnalyzeActuatorPerformance(iter([request])))
+                if any(r.status.startswith("ERROR") for r in resps):
+                    warm_errors += 1
+            except Exception:
+                warm_errors += 1
+        if servicer is not None:
+            # pre-compile every reachable batch bucket so the measured
+            # tail reflects serving, not one-off XLA compilation
+            servicer.warmup(w, h)
+
+        if cli.trace:
+            arrivals = trace_arrivals(cli.trace)
+            offered = (len(arrivals) / arrivals[-1]) if arrivals[-1] else 0.0
+            lat_ms, errors, wall = run_level(
+                stub, request, arrivals, cli.workers)
+            rows.append(summarize_level(lat_ms, errors, offered, wall,
+                                        slo_ms))
+        else:
+            for rate in loads:
+                arrivals = poisson_arrivals(rate, duration, rng)
+                if not arrivals:
+                    continue
+                lat_ms, errors, wall = run_level(
+                    stub, request, arrivals, cli.workers)
+                rows.append(summarize_level(lat_ms, errors, rate, wall,
+                                            slo_ms))
+                print(f"# offered={rate:.1f}rps n={len(lat_ms)} "
+                      f"errors={errors} "
+                      f"p50={rows[-1]['p50_ms']} p99={rows[-1]['p99_ms']}",
+                      file=sys.stderr)
+    finally:
+        channel.close()
+        if server is not None:
+            server.stop(grace=None)
+        if servicer is not None:
+            servicer.close()
+
+    import jax
+
+    payload = {
+        "metric": "open_loop_tail_latency",
+        "backend": jax.default_backend(),
+        "unit": "ms",
+        "arrivals": "trace" if cli.trace else "poisson",
+        "smoke": bool(cli.smoke),
+        "slo_ms": slo_ms,
+        "workers": cli.workers,
+        "frame": [w, h],
+        "rows": rows,
+    }
+    Path(cli.out).write_text(json.dumps(payload, indent=2) + "\n")
+
+    total_errors = warm_errors + sum(r["errors"] for r in rows)
+    top = rows[-1] if rows else {}
+    p99 = top.get("p99_ms")
+    _emit_result({
+        "metric": "open_loop_tail_latency",
+        "backend": jax.default_backend(),
+        # headline: p99 at the highest offered load that was measured
+        "value": p99 if p99 is not None and math.isfinite(p99) else 0.0,
+        "unit": "ms",
+        "offered_rps": top.get("offered_rps", 0.0),
+        "goodput_rps": top.get("goodput_rps", 0.0),
+        "violation_rate": top.get("violation_rate", 0.0),
+        "errors": total_errors,
+        "warm_errors": warm_errors,
+        "levels": len(rows),
+        "out": cli.out,
+        "smoke": bool(cli.smoke),
+    })
+
+
+if __name__ == "__main__":
+    _arm_deadline()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 -- structured artifact by design
+        import traceback
+
+        traceback.print_exc()
+        _emit_result(_error_payload(
+            "bench_error", f"{type(e).__name__}: {e}"))
+        sys.exit(0)

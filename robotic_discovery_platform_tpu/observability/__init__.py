@@ -23,6 +23,12 @@ analysis -> resilience -> observability triad:
   through serving, batching, resilience, tracking, and training (the
   resilience package stays import-clean of this one: it exposes injectable
   observer hooks that :mod:`instruments` installs).
+- :mod:`recorder` -- the flight recorder: the last N dispatch span
+  timelines in a bounded ring (``GET /debug/spans`` /
+  ``GET /debug/tracez``), error evidence pinned past wrap-around.
+- :mod:`slo` -- latency objectives (``ServerConfig.slo_ms`` /
+  ``RDP_SLO_MS``), violation counting, and error-budget burn -- the
+  signals the SLO-aware scheduler will consume.
 """
 
 from robotic_discovery_platform_tpu.observability.registry import (
@@ -31,6 +37,7 @@ from robotic_discovery_platform_tpu.observability.registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    Summary,
     time_histogram,
 )
 
@@ -40,5 +47,6 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "Summary",
     "time_histogram",
 ]

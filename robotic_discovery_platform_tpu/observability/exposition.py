@@ -1,12 +1,22 @@
-"""Prometheus text-format 0.0.4 rendering + the stdlib /metrics endpoint.
+"""Prometheus text-format 0.0.4 rendering + the stdlib debug endpoint.
 
 ``render`` serializes a :class:`~.registry.MetricsRegistry` into the
 Prometheus exposition format (the 0.0.4 text contract: ``# HELP`` /
 ``# TYPE`` headers, escaped help and label values, cumulative histogram
-buckets ending at ``+Inf``). ``MetricsServer`` is a daemon-thread
-``http.server`` wrapper serving ``GET /metrics`` -- deliberately not the
-gRPC port: scrapers and humans reach it with plain curl, and a wedged gRPC
-thread pool cannot take the diagnostics surface down with it.
+buckets ending at ``+Inf``, summary ``{quantile=...}`` samples).
+``MetricsServer`` is a daemon-thread ``http.server`` wrapper --
+deliberately not the gRPC port: scrapers and humans reach it with plain
+curl, and a wedged gRPC thread pool cannot take the diagnostics surface
+down with it. It serves:
+
+- ``GET /metrics`` -- the Prometheus scrape;
+- ``GET /debug/spans`` -- the flight recorder's recent + pinned dispatch
+  timelines as JSON (observability/recorder.py);
+- ``GET /debug/tracez`` -- the tracez-style per-span-name rollup;
+- ``GET /debug/profile?seconds=N`` -- an on-demand ``jax.profiler``
+  capture into ``RDP_PROFILE_DIR`` (409 when unset or a capture is
+  already running), so a TPU profile can be pulled from a live server
+  without restarting it.
 
 Lifecycle: ``serving.server.build_server`` starts one when
 ``ServerConfig.metrics_port`` / ``RDP_METRICS_PORT`` asks for it and
@@ -16,15 +26,21 @@ as long as the service it describes.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
+from robotic_discovery_platform_tpu.observability import (
+    recorder as recorder_lib,
+)
 from robotic_discovery_platform_tpu.observability.registry import (
     REGISTRY,
     MetricsRegistry,
 )
 from robotic_discovery_platform_tpu.utils.logging import get_logger
+from robotic_discovery_platform_tpu.utils.profiling import capture_profile
 
 log = get_logger(__name__)
 
@@ -75,27 +91,83 @@ def render(registry: MetricsRegistry = REGISTRY) -> str:
 
 
 class MetricsServer:
-    """``GET /metrics`` over stdlib ``http.server``, on a daemon thread.
+    """``GET /metrics`` + ``/debug/*`` over stdlib ``http.server``, on a
+    daemon thread.
 
     ``port=0`` binds an ephemeral port (tests; read it back from
     ``self.port``). ``start()`` returns self; ``stop()`` is idempotent."""
 
     def __init__(self, port: int, registry: MetricsRegistry = REGISTRY,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0",
+                 flight_recorder: "recorder_lib.FlightRecorder | None" = None,
+                 profile_dir: str | None = None):
         self._registry = registry
+        self._recorder = (flight_recorder if flight_recorder is not None
+                          else recorder_lib.RECORDER)
+        self._profile_dir = profile_dir
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server contract)
-                if self.path.split("?")[0] != "/metrics":
-                    self.send_error(404, "try /metrics")
-                    return
-                body = render(outer._registry).encode("utf-8")
-                self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                path, _, query = self.path.partition("?")
+                if path == "/metrics":
+                    body = render(outer._registry).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/debug/spans":
+                    self._send_json(outer._recorder.snapshot())
+                elif path == "/debug/tracez":
+                    self._send_json(outer._recorder.summary())
+                elif path == "/debug/profile":
+                    self._profile(query)
+                else:
+                    self.send_error(
+                        404, "try /metrics, /debug/spans, /debug/tracez, "
+                             "or /debug/profile?seconds=N")
+
+            def _send_json(self, payload: dict, status: int = 200):
+                body = json.dumps(payload, indent=1).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _profile(self, query: str):
+                """On-demand jax.profiler capture (utils/profiling.py)
+                into RDP_PROFILE_DIR; the capture runs synchronously on
+                this handler thread (ThreadingHTTPServer keeps /metrics
+                scrapes responsive meanwhile)."""
+                profile_dir = (outer._profile_dir
+                               or os.environ.get("RDP_PROFILE_DIR", "")
+                               ).strip()
+                if not profile_dir:
+                    self._send_json(
+                        {"error": "no profile directory configured; set "
+                                  "RDP_PROFILE_DIR"}, status=409)
+                    return
+                raw = parse_qs(query).get("seconds", ["1"])[0]
+                try:
+                    seconds = min(max(float(raw), 0.0), 60.0)
+                except ValueError:
+                    self._send_json(
+                        {"error": f"bad seconds value {raw!r}"}, status=400)
+                    return
+                try:
+                    target = capture_profile(profile_dir, seconds)
+                except RuntimeError as exc:  # capture already in progress
+                    self._send_json({"error": str(exc)}, status=409)
+                    return
+                files = sum(
+                    len(fs) for _, _, fs in os.walk(target)
+                )
+                log.info("profile capture: %.1fs -> %s (%d files)",
+                         seconds, target, files)
+                self._send_json({"profile_dir": target,
+                                 "seconds": seconds, "files": files})
 
             def log_message(self, fmt, *args):
                 pass  # scrapes every few seconds must not spam the log

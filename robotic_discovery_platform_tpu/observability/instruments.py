@@ -34,6 +34,41 @@ INFLIGHT_STREAMS = REGISTRY.gauge(
     "rdp_inflight_streams",
     "gRPC analysis streams currently open.",
 )
+STAGE_LATENCY_SUMMARY = REGISTRY.summary(
+    "rdp_stage_latency_summary_seconds",
+    "Streaming-quantile companion to rdp_stage_latency_seconds: "
+    "P^2-estimated p50/p95/p99/p99.9 per serving stage (decode, device, "
+    "encode, total), with no histogram bucket-resolution floor.",
+    ("stage",),
+)
+FRAME_LATENCY_SUMMARY = REGISTRY.summary(
+    "rdp_frame_latency_summary_seconds",
+    "End-to-end per-frame latency quantiles (request read to response "
+    "write) -- the SLO tracker's signal.",
+)
+
+# -- SLO (observability/slo.py; ServerConfig.slo_ms / RDP_SLO_MS) ------------
+
+SLO_OBJECTIVE = REGISTRY.gauge(
+    "rdp_slo_objective_seconds",
+    "Configured latency objective per tracked signal (absent families "
+    "mean SLO tracking is off).",
+    ("objective",),
+)
+SLO_VIOLATIONS = REGISTRY.counter(
+    "rdp_slo_violations_total",
+    "Frames that missed their latency objective (slower than the "
+    "objective, shed, or errored), per tracked signal.",
+    ("objective",),
+)
+SLO_BURN = REGISTRY.gauge(
+    "rdp_slo_error_budget_burn",
+    "Error-budget burn rate: sliding-window violation fraction divided "
+    "by the budgeted fraction (ServerConfig.slo_budget). Sustained "
+    "values > 1 mean the objective is being breached -- the adaptive "
+    "scheduler's retune trigger.",
+    ("objective",),
+)
 
 # -- batching ----------------------------------------------------------------
 

@@ -13,6 +13,14 @@ latency buckets by default (1 ms doubling to ~16 s) -- latency is this
 platform's dominant measured quantity and exponential buckets keep p99
 resolution roughly constant across four decades.
 
+Histograms answer "how is latency distributed" cheaply but their bucket
+resolution floors any percentile estimate; ``Summary`` complements them
+with *streaming quantiles*: per-child P^2 estimators (Jain & Chlamtac,
+CACM '85 -- five markers per tracked quantile, O(1) memory and update, no
+sample buffer) rendering Prometheus summary ``{quantile="0.5"}`` samples.
+That is the signal SLO tracking and the future adaptive scheduler consume
+directly, without a scrape-side histogram_quantile approximation.
+
 ``MetricsRegistry`` is get-or-create: asking twice for the same family
 returns the same object, and asking with a *different* type or label set
 raises -- two call sites silently disagreeing about a family's schema is
@@ -23,7 +31,9 @@ registries.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import math
 import re
 import threading
 import time
@@ -205,14 +215,19 @@ class _HistogramChild:
 
     def observe(self, value: float) -> None:
         value = float(value)
+        # bucket index via bisect over the sorted bounds (first bound with
+        # value <= bound), not a linear scan: observe() sits on the serving
+        # hot path and the default latency ladder is 15 buckets deep. NaN
+        # never compares <= any bound, so it keeps landing in the overflow
+        # slot (bisect would otherwise file it under the first bucket).
+        if value != value:  # NaN
+            i = len(self._buckets)
+        else:
+            i = bisect.bisect_left(self._buckets, value)
         with self._lock:
             self._sum += value
             self._count += 1
-            for i, bound in enumerate(self._buckets):
-                if value <= bound:
-                    self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+            self._counts[i] += 1
 
     @contextlib.contextmanager
     def time(self):
@@ -292,6 +307,194 @@ class Histogram(_Metric):
         return self._require_unlabeled().sum
 
 
+#: the quantiles every Summary tracks unless a family overrides them --
+#: the tail ladder SLO dashboards and the adaptive scheduler read.
+DEFAULT_QUANTILES: tuple[float, ...] = (0.5, 0.95, 0.99, 0.999)
+
+
+class P2Quantile:
+    """Streaming estimate of one quantile, P^2 algorithm (Jain & Chlamtac,
+    CACM 1985): five markers whose heights approximate the q-quantile and
+    its neighborhood, adjusted with a piecewise-parabolic fit on every
+    observation. O(1) memory and update, no stored samples -- exactly what
+    a per-label latency summary needs on the serving hot path.
+
+    Not thread-safe on its own; the owning Summary child locks around
+    ``observe``/``value`` (same policy as every other metric child)."""
+
+    __slots__ = ("q", "_heights", "_pos", "_want", "_step", "_count")
+
+    def __init__(self, q: float):
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {q}")
+        self.q = q
+        self._heights: list[float] = []  # marker heights (sorted)
+        self._pos = [1, 2, 3, 4, 5]  # actual marker positions (1-based)
+        self._want = [1.0, 1 + 2 * q, 1 + 4 * q, 3 + 2 * q, 5.0]
+        self._step = (0.0, q / 2, q, (1 + q) / 2, 1.0)
+        self._count = 0
+
+    def observe(self, x: float) -> None:
+        self._count += 1
+        if self._count <= 5:
+            bisect.insort(self._heights, x)
+            return
+        h, n = self._heights, self._pos
+        if x < h[0]:
+            h[0] = x
+            k = 0
+        elif x >= h[4]:
+            h[4] = x
+            k = 3
+        else:
+            k = 3
+            for i in range(1, 5):
+                if x < h[i]:
+                    k = i - 1
+                    break
+        for i in range(k + 1, 5):
+            n[i] += 1
+        for i in range(5):
+            self._want[i] += self._step[i]
+        for i in range(1, 4):
+            d = self._want[i] - n[i]
+            if ((d >= 1 and n[i + 1] - n[i] > 1)
+                    or (d <= -1 and n[i - 1] - n[i] < -1)):
+                s = 1 if d >= 0 else -1
+                cand = self._parabolic(i, s)
+                if not h[i - 1] < cand < h[i + 1]:
+                    cand = self._linear(i, s)
+                h[i] = cand
+                n[i] += s
+
+    def _parabolic(self, i: int, s: int) -> float:
+        h, n = self._heights, self._pos
+        return h[i] + s / (n[i + 1] - n[i - 1]) * (
+            (n[i] - n[i - 1] + s) * (h[i + 1] - h[i]) / (n[i + 1] - n[i])
+            + (n[i + 1] - n[i] - s) * (h[i] - h[i - 1]) / (n[i] - n[i - 1])
+        )
+
+    def _linear(self, i: int, s: int) -> float:
+        h, n = self._heights, self._pos
+        return h[i] + s * (h[i + s] - h[i]) / (n[i + s] - n[i])
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def value(self) -> float:
+        """The current estimate; exact while <= 5 samples, NaN when empty."""
+        if self._count == 0:
+            return math.nan
+        if self._count <= 5:
+            idx = max(0, math.ceil(self.q * self._count) - 1)
+            return self._heights[min(idx, self._count - 1)]
+        return self._heights[2]
+
+
+class _SummaryChild:
+    def __init__(self, lock: threading.Lock, quantiles: tuple[float, ...]):
+        self._lock = lock
+        self._est = {q: P2Quantile(q) for q in quantiles}
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for est in self._est.values():
+                est.observe(value)
+
+    @contextlib.contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return self._est[q].value
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def _samples(self, labels):
+        with self._lock:
+            est = [(q, e.value) for q, e in sorted(self._est.items())]
+            total, s = self._count, self._sum
+        if total:
+            # independent P^2 estimators can invert by an epsilon at low
+            # counts; exposition clamps to non-decreasing so consumers can
+            # rely on p50 <= p95 <= p99 <= p99.9 structurally
+            running = -math.inf
+            for q, v in est:
+                running = max(running, v)
+                yield Sample("", labels + (("quantile", _fmt_bound(q)),),
+                             running)
+        yield Sample("_sum", labels, s)
+        yield Sample("_count", labels, float(total))
+
+
+class Summary(_Metric):
+    """Streaming-quantile distribution (Prometheus summary semantics:
+    per-child ``{quantile="..."}`` gauges plus ``_sum``/``_count``),
+    backed by one :class:`P2Quantile` per tracked quantile. Complements a
+    histogram of the same signal: the histogram aggregates across
+    instances, the summary answers "what is p99 right now" exactly as the
+    SLO tracker and scheduler need it, with no bucket-resolution floor."""
+
+    kind = "summary"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = (),
+                 quantiles: Sequence[float] | None = None):
+        qs = (tuple(quantiles) if quantiles is not None
+              else DEFAULT_QUANTILES)
+        if not qs:
+            raise ValueError("summary needs at least one quantile")
+        if list(qs) != sorted(qs) or len(set(qs)) != len(qs):
+            raise ValueError(f"quantiles must be sorted and unique: {qs}")
+        for q in qs:
+            if not 0.0 < q < 1.0:
+                raise ValueError(f"quantile must be in (0, 1), got {q}")
+        if "quantile" in labelnames:
+            raise ValueError("'quantile' is reserved for summary samples")
+        self.quantiles = qs
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self, values):
+        return _SummaryChild(self._lock, self.quantiles)
+
+    def observe(self, value: float) -> None:
+        self._require_unlabeled().observe(value)
+
+    def time(self):
+        return self._require_unlabeled().time()
+
+    def quantile(self, q: float) -> float:
+        return self._require_unlabeled().quantile(q)
+
+    @property
+    def count(self) -> int:
+        return self._require_unlabeled().count
+
+    @property
+    def sum(self) -> float:
+        return self._require_unlabeled().sum
+
+
 @contextlib.contextmanager
 def time_histogram(hist):
     """Time a block into a histogram (family or labeled child)."""
@@ -344,6 +547,14 @@ class MetricsRegistry:
         return self._get_or_create(
             Histogram, name, help, labelnames,
             lambda: Histogram(name, help, labelnames, buckets),
+        )
+
+    def summary(self, name: str, help: str,
+                labelnames: Sequence[str] = (),
+                quantiles: Sequence[float] | None = None) -> Summary:
+        return self._get_or_create(
+            Summary, name, help, labelnames,
+            lambda: Summary(name, help, labelnames, quantiles),
         )
 
     def collect(self) -> list[_Metric]:

@@ -53,20 +53,70 @@ class SpanContext:
 
 @dataclass
 class Span:
-    """One timed operation; ``duration_s`` is set when the span closes."""
+    """One timed operation; ``duration_s`` is set when the span closes.
+    ``start_ns``/``end_ns`` are ``time.monotonic_ns`` stamps (comparable
+    across threads within the process) and ``attributes`` carry string
+    key/values -- both feed :class:`SpanRecord` conversion for the flight
+    recorder."""
 
     name: str
     context: SpanContext
     started_at: float = field(default_factory=time.perf_counter)
     duration_s: float | None = None
+    start_ns: int = field(default_factory=time.monotonic_ns)
+    end_ns: int | None = None
+    attributes: dict[str, str] = field(default_factory=dict)
 
     @property
     def trace_id(self) -> str:
         return self.context.trace_id
 
+    def set_attribute(self, key: str, value) -> None:
+        self.attributes[str(key)] = str(value)
+
 
 def _hex_id(nbytes: int) -> str:
     return os.urandom(nbytes).hex()
+
+
+@dataclass
+class SpanRecord:
+    """One *recorded* span: inert data for the flight recorder's ring and
+    the ``/debug/spans`` JSON, as opposed to :class:`Span` (the live,
+    contextvar-scoped object). Start/end are ``time.monotonic_ns`` stamps
+    -- nanosecond resolution, comparable across the pipeline's threads --
+    with an explicit parent link and string attributes, so a timeline's
+    span tree reconstructs without any contextvar state."""
+
+    name: str
+    span_id: str = field(default_factory=lambda: _hex_id(8))
+    parent_id: str | None = None
+    trace_id: str | None = None
+    start_ns: int = 0
+    end_ns: int | None = None
+    attributes: dict[str, str] = field(default_factory=dict)
+
+    def end(self, ns: int | None = None) -> "SpanRecord":
+        self.end_ns = time.monotonic_ns() if ns is None else int(ns)
+        return self
+
+    @property
+    def duration_ms(self) -> float | None:
+        if self.end_ns is None:
+            return None
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "trace_id": self.trace_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "duration_ms": self.duration_ms,
+            "attributes": dict(self.attributes),
+        }
 
 
 def new_context(parent: SpanContext | None = None) -> SpanContext:
@@ -97,6 +147,7 @@ def span(name: str, parent: SpanContext | None = None):
         yield sp
     finally:
         _current.reset(token)
+        sp.end_ns = time.monotonic_ns()
         sp.duration_s = time.perf_counter() - sp.started_at
 
 

@@ -85,6 +85,17 @@ dispatch overlapped the next one's staging/compute), stage-split latency
 (``rdp_batch_stage_seconds``: stage / launch / complete), watchdog restart
 counter; each submit carries its stream's span context across the
 collector-thread hop so dispatch failures can name the traces they hit.
+
+Flight recorder (observability/recorder.py): every dispatch additionally
+records one span **timeline** -- per-frame ``submit`` spans (queue +
+window wait, carrying each frame's trace ID), ``collect``, ``stage``
+(host fill + H2D), ``launch`` (async jit dispatch), and ``complete``
+(blocking D2H + fan-out), all children of one ``dispatch`` root labeled
+with the routed ``chip``, padded ``bucket``, and dispatch ``mode`` --
+into the bounded ring behind ``GET /debug/spans``. Failed dispatches and
+watchdog restarts are pinned so post-mortems never race the ring. The
+recorder only ever touches host-side ``monotonic_ns`` stamps: serial-mode
+(depth-1, 1-chip) results stay bit-identical with it enabled.
 """
 
 from __future__ import annotations
@@ -102,6 +113,7 @@ import numpy as np
 from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 from robotic_discovery_platform_tpu.observability import (
     instruments as obs,
+    recorder as recorder_lib,
     trace,
 )
 from robotic_discovery_platform_tpu.ops import pipeline as pipeline_lib
@@ -213,6 +225,9 @@ class _Pending:
     # (contextvars do not flow into the collector thread) so dispatch-side
     # logs can name the traces of the frames they affected
     trace_ctx: Any = None
+    # when the frame entered the queue; the flight recorder's per-frame
+    # "submit" span (queue + window wait) starts here
+    submit_ns: int = field(default_factory=time.monotonic_ns)
 
 
 class _BucketBuffers:
@@ -249,6 +264,10 @@ class _Dispatch:
     # which routed chip (ring index) launched this dispatch; 0 for the
     # single-device and data-sharded windows
     chip: int = 0
+    # this dispatch's flight-recorder timeline + its root span; the
+    # completer closes the root and records the timeline
+    timeline: Any = None
+    root: Any = None
 
 
 def _bucket(n: int, max_batch: int) -> int:
@@ -288,6 +307,10 @@ class BatchDispatcher:
         router: optional :class:`DeviceRouter` spreading dispatches across
             a serving mesh. None (default) keeps today's single-device
             dispatch exactly.
+        flight_recorder: where per-dispatch span timelines are recorded
+            (observability/recorder.py); defaults to the process-global
+            ``RECORDER`` behind ``GET /debug/spans``. Tests inject a
+            private one.
     """
 
     def __init__(self, analyze_batch: Callable, window_ms: float = 2.0,
@@ -295,8 +318,11 @@ class BatchDispatcher:
                  submit_timeout_s: float = 30.0,
                  watchdog_interval_s: float = 1.0,
                  max_inflight: int = 2,
-                 router: DeviceRouter | None = None):
+                 router: DeviceRouter | None = None,
+                 flight_recorder: recorder_lib.FlightRecorder | None = None):
         self._analyze = analyze_batch
+        self._recorder = (flight_recorder if flight_recorder is not None
+                          else recorder_lib.RECORDER)
         self._window_s = window_ms / 1e3
         self._max_batch = max_batch
         self._max_backlog = max_backlog
@@ -503,6 +529,13 @@ class BatchDispatcher:
                 if completer_dead:
                     self.completer_restarts += 1
                 obs.WATCHDOG_RESTARTS.inc()
+                # pinned restart event: the post-mortem evidence must not
+                # be overwritten by the healthy traffic that follows
+                self._recorder.record_event(
+                    "watchdog_restart", stage=dead,
+                    error=f"batch {dead} thread died; "
+                          f"{len(self._pending)} pending frame(s) failed",
+                )
                 log.error(
                     "batch %s thread died unexpectedly; failing %d "
                     "pending frame(s) and restarting (restart #%d)",
@@ -578,11 +611,12 @@ class BatchDispatcher:
             # here kills the collector thread itself, which is exactly the
             # failure mode the watchdog exists for
             inject("serving.batch.collect")
+            collected_ns = time.monotonic_ns()
             by_shape: dict[tuple, list[_Pending]] = {}
             for p in batch:
                 by_shape.setdefault(p.frame_rgb.shape[:2], []).append(p)
             for group in by_shape.values():
-                self._launch_group(group)
+                self._launch_group(group, collected_ns)
 
     def _pool_take(self, key: tuple, template: _Pending) -> _BucketBuffers:
         with self._pool_lock:
@@ -688,10 +722,13 @@ class BatchDispatcher:
             bufs.scales[n:] = bufs.scales[0]
         return bufs, bufs.frames, bufs.depths, bufs.intr, bufs.scales
 
-    def _launch_group(self, group: list[_Pending]) -> None:
+    def _launch_group(self, group: list[_Pending],
+                      collected_ns: int | None = None) -> None:
         """Stage + H2D + async launch of one geometry group onto the routed
         chip, then hand the in-flight dispatch to the completer. Never
         blocks on the result."""
+        if collected_ns is None:
+            collected_ns = time.monotonic_ns()
         # bounded in-flight window, per routed chip: dispatch N+1 on a chip
         # may not launch until one of THAT chip's slots frees (at most
         # max_inflight batches hold each chip's device memory). The pick is
@@ -704,6 +741,25 @@ class BatchDispatcher:
                     group, RuntimeError("dispatcher stopped"), log_it=False
                 )
                 return
+        # the flight-recorder timeline for this dispatch: the root opens
+        # at the earliest member frame's submit, per-frame "submit" spans
+        # cover queue + window wait and carry each frame's trace ID
+        first_submit_ns = min(p.submit_ns for p in group)
+        tl = recorder_lib.Timeline("dispatch", labels={
+            "chip": str(chip),
+            "mode": (self._router.mode if self._router is not None
+                     else "single"),
+        })
+        root = tl.span("dispatch", start_ns=first_submit_ns)
+        tl.span("collect", start_ns=first_submit_ns, end_ns=collected_ns,
+                parent=root, frames=len(group))
+        for p in group:
+            tl.span(
+                "submit", start_ns=p.submit_ns, end_ns=collected_ns,
+                parent=root,
+                trace_id=(p.trace_ctx.trace_id
+                          if p.trace_ctx is not None else None),
+            )
         bufs = None
         launched = False
         try:
@@ -711,17 +767,22 @@ class BatchDispatcher:
             n = len(group)
             obs.BATCH_SIZE.observe(n)
             b = self.bucket_for(n)
-            t0 = time.monotonic()
+            tl.labels["bucket"] = str(b)
+            t0 = time.monotonic_ns()
             bufs, frames, depths, intr, scales = self._stage_group(group, b)
             staged = pipeline_lib.stage_batch(
                 frames, depths, intr, scales, device=self._placement(chip)
             )
-            t1 = time.monotonic()
+            t1 = time.monotonic_ns()
             # jit async dispatch: returns once the computation is enqueued
             out = self._analyze_for(chip)(*staged)
-            t2 = time.monotonic()
-            obs.BATCH_STAGE_LATENCY.labels(stage="stage").observe(t1 - t0)
-            obs.BATCH_STAGE_LATENCY.labels(stage="launch").observe(t2 - t1)
+            t2 = time.monotonic_ns()
+            tl.span("stage", start_ns=t0, end_ns=t1, parent=root)
+            tl.span("launch", start_ns=t1, end_ns=t2, parent=root)
+            obs.BATCH_STAGE_LATENCY.labels(stage="stage").observe(
+                (t1 - t0) / 1e9)
+            obs.BATCH_STAGE_LATENCY.labels(stage="launch").observe(
+                (t2 - t1) / 1e9)
             with self._inflight_lock:
                 self._inflight_count += 1
                 self.inflight_high_water = max(
@@ -740,9 +801,14 @@ class BatchDispatcher:
                 )
             obs.CHIP_DISPATCHES.labels(chip=str(chip)).inc()
             obs.CHIP_FRAMES.labels(chip=str(chip)).inc(n)
-            self._cq.put(_Dispatch(group, out, bufs, slot, t2, chip))
+            self._cq.put(_Dispatch(group, out, bufs, slot, t2 / 1e9, chip,
+                                   timeline=tl, root=root))
             launched = True
         except BaseException as exc:  # deliver, don't kill the collector
+            # the failed dispatch's timeline is evidence: close it, mark
+            # the error, record it (record() pins errored timelines)
+            root.end()
+            self._recorder.record(tl.fail(exc))
             self._fail_group(group, exc)
             self._pool_put(bufs)
         finally:
@@ -756,7 +822,8 @@ class BatchDispatcher:
             d = self._cq.get()
             if d is None:
                 return
-            t_pop = time.monotonic()
+            pop_ns = time.monotonic_ns()
+            t_pop = pop_ns / 1e9
             try:
                 inject("serving.batch.complete")
                 # the ONE blocking host fetch, off the collector's critical
@@ -767,9 +834,18 @@ class BatchDispatcher:
                     p.result = jax.tree.map(lambda a, _i=i: a[_i], host)
                     p.done.set()
             except BaseException as exc:  # deliver, keep draining
+                if d.timeline is not None:
+                    d.timeline.fail(exc)
                 self._fail_group(d.group, exc)
             finally:
-                done_t = time.monotonic()
+                done_ns = time.monotonic_ns()
+                done_t = done_ns / 1e9
+                if d.timeline is not None:
+                    d.timeline.span("complete", start_ns=pop_ns,
+                                    end_ns=done_ns, parent=d.root)
+                    d.root.end(done_ns)
+                    # record() pins the timeline when an error marked it
+                    self._recorder.record(d.timeline)
                 # overlap: how long this dispatch's predecessor was still
                 # completing after this one had already launched. Serial
                 # mode (max_inflight=1) launches only after the previous

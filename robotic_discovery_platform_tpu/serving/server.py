@@ -46,7 +46,13 @@ Observability (observability/ package):
   is set -- started here, stopped in close();
 - each stream adopts the client's ``traceparent`` (W3C trace context) from
   gRPC metadata, so client- and server-side log lines carry the same
-  [trace=...] stamp.
+  [trace=...] stamp -- and per-frame error statuses / shed
+  RESOURCE_EXHAUSTED details carry ``[trace=...]`` too, so a client-side
+  failure joins its ``GET /debug/spans`` timeline;
+- per-stage and end-to-end latency additionally feed streaming-quantile
+  summaries (``rdp_*_summary_seconds``: P^2 p50/p95/p99/p99.9), and when
+  ServerConfig.slo_ms / RDP_SLO_MS sets an objective every frame feeds
+  the SLO tracker (``rdp_slo_violations_total``, error-budget burn).
 """
 
 from __future__ import annotations
@@ -65,6 +71,8 @@ from robotic_discovery_platform_tpu.io.frames import load_calibration
 from robotic_discovery_platform_tpu.observability import (
     exposition,
     instruments as obs,
+    recorder as recorder_lib,
+    slo as slo_lib,
     trace,
 )
 from robotic_discovery_platform_tpu.ops import pipeline
@@ -242,6 +250,21 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # Prometheus exposition endpoint; build_server starts one when
         # cfg.metrics_port / RDP_METRICS_PORT asks for it, close() stops it
         self.metrics_server: exposition.MetricsServer | None = None
+        # End-to-end latency SLO (observability/slo.py): every frame's
+        # total latency feeds the violation counter and the error-budget
+        # burn gauge. Off unless cfg.slo_ms / RDP_SLO_MS sets an objective.
+        self.slo: slo_lib.SloTracker | None = None
+        slo_ms = slo_lib.resolve_slo_ms(cfg.slo_ms)
+        if slo_ms is not None:
+            self.slo = slo_lib.SloTracker(
+                slo_ms / 1e3, budget=cfg.slo_budget, window=cfg.slo_window,
+                name="e2e",
+                violations=obs.SLO_VIOLATIONS.labels(objective="e2e"),
+                burn_gauge=obs.SLO_BURN.labels(objective="e2e"),
+                objective_gauge=obs.SLO_OBJECTIVE.labels(objective="e2e"),
+            )
+            log.info("SLO tracking: %.1f ms objective, %.2f%% budget",
+                     slo_ms, 100 * cfg.slo_budget)
 
     @property
     def variables(self):
@@ -473,10 +496,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             # rdp_stage_latency_seconds histogram (ONE timing system: the
             # exported histogram and the log summary observe the same
             # measurements)
-            timer = StageTimer(
-                observer=lambda stage, dt:
-                    obs.STAGE_LATENCY.labels(stage=stage).observe(dt)
-            )
+            def _observe_stage(stage: str, dt: float) -> None:
+                obs.STAGE_LATENCY.labels(stage=stage).observe(dt)
+                obs.STAGE_LATENCY_SUMMARY.labels(stage=stage).observe(dt)
+
+            timer = StageTimer(observer=_observe_stage)
             for request in request_iterator:
                 # honor cancellation and the client's deadline BEFORE
                 # paying decode + device time for a frame nobody is
@@ -514,10 +538,16 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     # load shedding is a STREAM-level, retryable condition:
                     # surface the standard backpressure status instead of a
                     # per-frame error payload the client cannot distinguish
-                    # from a bad frame
+                    # from a bad frame. The trace ID rides the details so
+                    # the client-side failure joins its /debug/spans
+                    # timeline; a shed frame also burned SLO budget.
                     obs.FRAMES.labels(status="shed").inc()
-                    context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
-                                  str(exc))
+                    if self.slo is not None:
+                        self.slo.observe(float("inf"), ok=False)
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED,
+                        f"{exc} [trace={trace.current_trace_id() or '-'}]",
+                    )
                 except DeadlineExceeded as exc:
                     # per-submit deadline (client deadline or
                     # cfg.submit_deadline_s) ran out while the frame was
@@ -525,19 +555,36 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     # stream alive -- the handler thread is free again
                     log.warning("frame missed its deadline: %s", exc)
                     response = vision_pb2.AnalysisResponse(
-                        status=f"ERROR: DeadlineExceeded: {exc}"
+                        status=f"ERROR: DeadlineExceeded: {exc} "
+                               f"[trace={trace.current_trace_id() or '-'}]"
                     )
                     status_label = "deadline"
                 except Exception as exc:  # keep the stream alive per frame
                     log.exception("analysis error")
+                    # trace ID in the wire status AND a pinned recorder
+                    # event: the client-side failure and the server-side
+                    # /debug/spans evidence join on the same 32-hex ID
+                    trace_id = trace.current_trace_id()
+                    recorder_lib.RECORDER.record_event(
+                        "serving.frame_error", trace_id=trace_id,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
                     response = vision_pb2.AnalysisResponse(
-                        status=f"ERROR: {type(exc).__name__}: {exc}"
+                        status=f"ERROR: {type(exc).__name__}: {exc} "
+                               f"[trace={trace_id or '-'}]"
                     )
                     status_label = "error"
                 total_s = time.perf_counter() - t0
                 response.proc_time_ms = total_s * 1e3
                 obs.FRAMES.labels(status=status_label).inc()
                 obs.STAGE_LATENCY.labels(stage="total").observe(total_s)
+                obs.STAGE_LATENCY_SUMMARY.labels(stage="total").observe(
+                    total_s)
+                obs.FRAME_LATENCY_SUMMARY.observe(total_s)
+                if self.slo is not None:
+                    self.slo.observe(
+                        total_s, ok=status_label in ("ok", "degraded")
+                    )
                 yield response
             self.metrics.flush()
             if timer.totals:

@@ -262,6 +262,19 @@ class ServerConfig:
     # Graceful shutdown: how long close() waits for in-flight streams to
     # finish after readiness flips to NOT_SERVING.
     drain_grace_s: float = 5.0
+    # -- SLO telemetry (robotic_discovery_platform_tpu/observability/slo.py)
+    # End-to-end per-frame latency objective in milliseconds. Frames
+    # slower than this -- or shed / errored -- count into
+    # rdp_slo_violations_total and drive the error-budget-burn gauge the
+    # adaptive scheduler will consume. 0 (default) disables SLO tracking.
+    # The RDP_SLO_MS env var overrides this value.
+    slo_ms: float = 0.0
+    # Error budget: the fraction of frames ALLOWED to miss the objective.
+    # Burn = (violating fraction over the sliding window) / budget;
+    # sustained burn > 1 means the objective is being breached.
+    slo_budget: float = 0.01
+    # Sliding-window length (frames) for the burn-rate estimate.
+    slo_window: int = 512
 
 
 @dataclass(frozen=True)

@@ -10,6 +10,7 @@ capture around compiled steps.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 from collections import defaultdict
@@ -87,3 +88,42 @@ def jax_trace(log_dir: str | None):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# one capture at a time: the jax profiler is process-global state, and two
+# interleaved start/stop_trace calls corrupt both captures
+_capture_lock = threading.Lock()
+
+
+def capture_profile(log_dir: str, seconds: float = 1.0) -> str:
+    """One on-demand ``jax.profiler`` capture into a fresh timestamped
+    subdirectory of ``log_dir``; returns that subdirectory.
+
+    This is the ``GET /debug/profile?seconds=N`` backend: a live server's
+    traffic during the window lands in the trace, and a small jitted op
+    runs inside it so the capture is non-empty even on an idle server
+    (tests assert exactly that). Raises RuntimeError when a capture is
+    already in progress -- the caller surfaces that as HTTP 409 rather
+    than corrupting the running capture."""
+    if not _capture_lock.acquire(blocking=False):
+        raise RuntimeError("a profile capture is already in progress")
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        target = os.path.join(
+            log_dir, time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}"
+        )
+        os.makedirs(target, exist_ok=True)
+        deadline = time.monotonic() + max(0.0, float(seconds))
+        with jax_trace(target):
+            # guarantee at least one device event in the window
+            jax.block_until_ready(jnp.square(jnp.arange(64.0)))
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                time.sleep(min(0.05, remaining))
+        return target
+    finally:
+        _capture_lock.release()

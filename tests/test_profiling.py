@@ -1,12 +1,19 @@
-"""Profiling helpers: StageTimer math and a real jax.profiler capture
+"""Profiling helpers: StageTimer math, a real jax.profiler capture
 (SURVEY.md section 5.1 -- the reference reserves proc_time_ms and imports
-time but never measures anything)."""
+time but never measures anything), and the on-demand /debug/profile
+capture trigger on the exposition server."""
 
+import json
 import time
+import urllib.request
 
 import jax.numpy as jnp
 
-from robotic_discovery_platform_tpu.utils.profiling import StageTimer, jax_trace
+from robotic_discovery_platform_tpu.utils.profiling import (
+    StageTimer,
+    capture_profile,
+    jax_trace,
+)
 
 
 def test_stage_timer_accumulates():
@@ -34,3 +41,48 @@ def test_jax_trace_captures(tmp_path):
 def test_jax_trace_noop_without_dir():
     with jax_trace(None):
         pass  # must not require jax.profiler state
+
+
+def test_capture_profile_writes_nonempty_dir(tmp_path):
+    """On-demand capture: a fresh timestamped subdir with trace files in
+    it, even with no traffic (the capture runs its own device op)."""
+    target = capture_profile(str(tmp_path / "prof"), seconds=0.1)
+    captured = [p for p in (tmp_path / "prof").rglob("*") if p.is_file()]
+    assert captured, "no trace files written"
+    assert str(tmp_path / "prof") in target
+
+
+def test_debug_profile_endpoint_captures(tmp_path, monkeypatch):
+    """GET /debug/profile?seconds=N on the exposition server captures a
+    TPU/CPU profile into RDP_PROFILE_DIR from a LIVE server -- no restart
+    -- and 409s when no directory is configured."""
+    import urllib.error
+
+    from robotic_discovery_platform_tpu.observability import exposition
+    from robotic_discovery_platform_tpu.observability.registry import (
+        MetricsRegistry,
+    )
+
+    monkeypatch.setenv("RDP_PROFILE_DIR", str(tmp_path / "prof"))
+    srv = exposition.MetricsServer(0, MetricsRegistry(),
+                                   host="127.0.0.1").start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/debug/profile?seconds=0.1"
+        with urllib.request.urlopen(url, timeout=60) as resp:
+            payload = json.loads(resp.read())
+        assert payload["files"] >= 1
+        from pathlib import Path
+
+        captured = [p for p in Path(payload["profile_dir"]).rglob("*")
+                    if p.is_file()]
+        assert captured, "capture directory is empty"
+        # unset dir -> 409, not a crash
+        monkeypatch.delenv("RDP_PROFILE_DIR")
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/profile", timeout=10)
+            raise AssertionError("expected HTTP 409")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+    finally:
+        srv.stop()

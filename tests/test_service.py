@@ -2,7 +2,10 @@
 port with a synthetic frame source (the test seam the reference lacks,
 SURVEY.md section 4c)."""
 
+import json
+import sys
 import time
+from pathlib import Path
 
 import grpc
 import numpy as np
@@ -779,6 +782,155 @@ def test_trace_propagation_client_to_server(running_server, caplog):
     }
     assert len(client_ids) == 1 and "-" not in client_ids
     assert client_ids == server_ids
+
+
+def test_error_response_carries_trace_id(running_server):
+    """A per-frame error status carries [trace=<id>] matching the trace
+    the CLIENT sent over traceparent metadata, so a client-side failure
+    joins its server-side /debug/spans evidence."""
+    from robotic_discovery_platform_tpu.observability import trace
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+
+    address, _, _ = running_server
+    channel = grpc.insecure_channel(address)
+    stub = vision_grpc.VisionAnalysisServiceStub(channel)
+    ctx = trace.new_context()
+
+    def requests():
+        yield vision_pb2.AnalysisRequest(
+            color_image=vision_pb2.Image(data=b"not an image"),
+            depth_image=vision_pb2.Image(data=b"nope"),
+        )
+
+    (response,) = list(stub.AnalyzeActuatorPerformance(
+        requests(), metadata=trace.to_metadata(ctx)
+    ))
+    channel.close()
+    assert response.status.startswith("ERROR")
+    assert f"[trace={ctx.trace_id}]" in response.status
+
+
+def test_shed_details_carry_trace_id(registered_model, tmp_path):
+    """RESOURCE_EXHAUSTED shed details carry the stream's trace ID too
+    (max_backlog=0 sheds every submit)."""
+    from robotic_discovery_platform_tpu.observability import trace
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=registered_model,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        calibration_path=str(tmp_path / "missing.npz"),
+        batch_window_ms=5.0,
+        max_backlog=0,
+        reload_poll_s=0.0,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        channel = grpc.insecure_channel(f"localhost:{port}")
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        ctx = trace.new_context()
+        src = SyntheticSource(width=64, height=64, n_frames=1)
+        src.start()
+        color, depth = src.get_frames()
+        src.stop()
+
+        with pytest.raises(grpc.RpcError) as excinfo:
+            list(stub.AnalyzeActuatorPerformance(
+                iter([client_lib.encode_request(color, depth)]),
+                metadata=trace.to_metadata(ctx),
+            ))
+        channel.close()
+        assert excinfo.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert f"[trace={ctx.trace_id}]" in excinfo.value.details()
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+
+
+def test_debug_spans_quantiles_and_slo_live_server(registered_model,
+                                                   tmp_path):
+    """The acceptance surface end to end: a live batching server
+    streaming frames yields /debug/spans timelines whose stage spans are
+    properly nested and chip-labeled, /metrics quantile gauges with
+    p50 <= p95 <= p99, live SLO families, and a /debug/tracez rollup."""
+    import urllib.request
+
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=registered_model,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        metrics_flush_every=1,
+        calibration_path=str(tmp_path / "missing.npz"),
+        batch_window_ms=10.0,
+        max_batch=4,
+        metrics_port=-1,  # ephemeral; read back below
+        slo_ms=30000.0,   # generous: violations not expected, families live
+        reload_poll_s=0.0,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        assert servicer.slo is not None
+        source = SyntheticSource(width=160, height=120, seed=9, n_frames=6)
+        client_lib.run_client(
+            ClientConfig(server_address=f"localhost:{port}",
+                         calibration_path="none.npz"),
+            source=source, max_frames=6,
+        )
+        base = f"http://127.0.0.1:{servicer.metrics_server.port}"
+        with urllib.request.urlopen(f"{base}/debug/spans", timeout=10) as r:
+            spans_payload = json.loads(r.read())
+        # this server's dispatches are in the (process-global) ring; find
+        # complete ones and check structure
+        mine = [
+            t for t in spans_payload["recent"]
+            if t["name"] == "dispatch" and t["error"] is None
+            and {s["name"] for s in t["spans"]} >= {
+                "dispatch", "submit", "collect", "stage", "launch",
+                "complete"}
+        ]
+        assert mine, "no complete dispatch timelines recorded"
+        tl = mine[-1]
+        assert tl["labels"]["chip"] == "0"
+        assert tl["labels"]["bucket"] in {"1", "2", "4"}
+        root = tl["spans"][0]
+        for sp in tl["spans"][1:]:
+            assert sp["parent_id"] == root["span_id"]
+            assert sp["start_ns"] >= root["start_ns"]
+            assert sp["end_ns"] <= root["end_ns"]
+        # the stage pipeline is ordered: stage ends before launch ends
+        # before the completion closes the root
+        by_name = {s["name"]: s for s in tl["spans"]}
+        assert (by_name["stage"]["end_ns"] <= by_name["launch"]["end_ns"]
+                <= by_name["complete"]["end_ns"])
+        # submit spans carry the client's trace IDs
+        submits = [s for s in tl["spans"] if s["name"] == "submit"]
+        assert all(s["trace_id"] for s in submits)
+
+        with urllib.request.urlopen(f"{base}/debug/tracez", timeout=10) as r:
+            tracez = json.loads(r.read())
+        assert tracez["spans"]["dispatch"]["count"] >= 1
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        # quantile ladder is monotone for every stage that sampled
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+        from tools.metrics_smoke import quantile_values
+
+        q = quantile_values(text, "rdp_frame_latency_summary_seconds")
+        ladder = [q[k] for k in ("0.5", "0.95", "0.99", "0.999")]
+        assert all(v > 0 for v in ladder)
+        assert ladder == sorted(ladder)
+        assert 'rdp_slo_objective_seconds{objective="e2e"} 30\n' in text
+        assert 'rdp_slo_violations_total{objective="e2e"}' in text
+        assert 'rdp_slo_error_budget_burn{objective="e2e"}' in text
+    finally:
+        server.stop(grace=None)
+        servicer.close()
 
 
 def test_metrics_endpoint_serves_prometheus(registered_model, tmp_path):

@@ -1,0 +1,390 @@
+"""PR-6 telemetry trio: P^2 streaming quantiles (Summary metric), the
+flight recorder ring (span timelines, pinning, concurrency), SLO
+tracking, and the open-loop load-harness helpers."""
+
+import json
+import sys
+import threading
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.observability import (
+    exposition,
+    recorder as recorder_lib,
+    slo as slo_lib,
+)
+from robotic_discovery_platform_tpu.observability.registry import (
+    MetricsRegistry,
+    P2Quantile,
+)
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench_load  # noqa: E402
+
+# -- P^2 streaming quantiles -------------------------------------------------
+
+
+def _streams():
+    """Uniform / lognormal / bimodal test streams. The bimodal mix is
+    weighted 40/60 so every tested quantile falls INSIDE a mode -- P^2's
+    documented weak spot is a quantile landing in the empty valley
+    between modes, where no estimator has a well-defined answer."""
+    rng = np.random.default_rng(7)
+    streams = {
+        "uniform": rng.uniform(0.0, 1.0, 20000),
+        "lognormal": rng.lognormal(0.0, 1.0, 20000),
+        "bimodal": np.concatenate([
+            rng.normal(1.0, 0.1, 8000), rng.normal(10.0, 1.0, 12000),
+        ]),
+    }
+    for data in streams.values():
+        rng.shuffle(data)
+    return streams
+
+
+@pytest.mark.parametrize("name", ["uniform", "lognormal", "bimodal"])
+@pytest.mark.parametrize("q", [0.5, 0.95, 0.99])
+def test_p2_tracks_np_percentile(name, q):
+    """Property-style bound: the streaming estimate lands within 10% of
+    np.percentile's exact answer, normalized by the distribution's
+    central spread (absolute-relative error is meaningless where the
+    density is near zero)."""
+    data = _streams()[name]
+    est = P2Quantile(q)
+    for x in data:
+        est.observe(float(x))
+    true = float(np.percentile(data, 100 * q))
+    spread = float(np.percentile(data, 99.9) - np.percentile(data, 0.1))
+    assert abs(est.value - true) <= 0.10 * spread, (
+        f"{name} q={q}: est={est.value} true={true}"
+    )
+
+
+def test_p2_extreme_tail_is_finite_and_ordered():
+    data = _streams()["lognormal"]
+    ests = {q: P2Quantile(q) for q in (0.99, 0.999)}
+    for x in data:
+        for e in ests.values():
+            e.observe(float(x))
+    assert np.isfinite(ests[0.999].value)
+    assert ests[0.999].value >= ests[0.99].value
+
+
+def test_p2_small_samples_are_exact():
+    est = P2Quantile(0.5)
+    assert np.isnan(est.value)  # empty
+    for x in (5.0, 1.0, 3.0):
+        est.observe(x)
+    assert est.value == 3.0  # exact median of {1, 3, 5}
+    with pytest.raises(ValueError):
+        P2Quantile(1.5)
+
+
+def test_summary_independent_label_children():
+    """Merge-under-labels semantics: each label combination keeps its own
+    estimator state; observing one child never perturbs another."""
+    reg = MetricsRegistry()
+    s = reg.summary("lat_seconds", "latency", ("stage",))
+    rng = np.random.default_rng(0)
+    fast, slow = rng.uniform(0, 0.01, 4000), rng.uniform(1.0, 2.0, 4000)
+    for x in fast:
+        s.labels(stage="fast").observe(float(x))
+    for x in slow:
+        s.labels(stage="slow").observe(float(x))
+    assert s.labels(stage="fast").quantile(0.99) < 0.011
+    assert s.labels(stage="slow").quantile(0.5) > 0.9
+    assert s.labels(stage="fast").count == 4000
+    assert s.labels(stage="slow").sum == pytest.approx(float(slow.sum()))
+
+
+def test_summary_schema_validation():
+    reg = MetricsRegistry()
+    s = reg.summary("s_seconds", "s")
+    assert reg.summary("s_seconds", "s") is s  # get-or-create
+    with pytest.raises(ValueError):
+        reg.histogram("s_seconds", "s")  # same name, different kind
+    with pytest.raises(ValueError):
+        reg.summary("t_seconds", "t", ("quantile",))  # reserved label
+    with pytest.raises(ValueError):
+        reg.summary("u_seconds", "u", quantiles=(0.9, 0.5))  # unsorted
+    with pytest.raises(ValueError):
+        reg.summary("v_seconds", "v", quantiles=())
+
+
+def test_summary_exposition_monotone_and_formatted():
+    """Summary renders Prometheus summary series -- ``{quantile="..."}``
+    gauges clamped non-decreasing, plus _sum/_count -- and an empty child
+    renders only _sum/_count (no NaN quantile lines)."""
+    reg = MetricsRegistry()
+    s = reg.summary("q_seconds", "q")
+    text = exposition.render(reg)
+    assert "# TYPE q_seconds summary\n" in text
+    assert "quantile=" not in text  # empty: no quantile samples yet
+    assert "q_seconds_count 0\n" in text
+    rng = np.random.default_rng(1)
+    for x in rng.uniform(0, 1, 3000):
+        s.observe(float(x))
+    text = exposition.render(reg)
+    values = []
+    for q in ("0.5", "0.95", "0.99", "0.999"):
+        needle = f'q_seconds{{quantile="{q}"}} '
+        assert needle in text, text
+        line = next(ln for ln in text.splitlines() if ln.startswith(needle))
+        values.append(float(line.rsplit(" ", 1)[1]))
+    assert values == sorted(values)  # p50 <= p95 <= p99 <= p99.9
+    assert f"q_seconds_count {s.count}\n" in text
+
+
+def test_histogram_bisect_boundary_semantics():
+    """The bisect fast path keeps exact ``value <= bound`` bucketing,
+    including values ON a bound, above the top bucket, and NaN (which
+    must stay in the overflow slot, not bucket 0)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("b_seconds", "b", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 2.0, 3.0, 4.0, 5.0, float("nan")):
+        h.observe(v)
+    (metric,) = reg.collect()
+    by_le = {
+        dict(s.labels)["le"]: s.value
+        for s in metric.samples() if s.suffix == "_bucket"
+    }
+    # cumulative: le=1 gets {0.5, 1.0}; le=2 adds 2.0; le=4 adds {3, 4};
+    # +Inf adds 5.0 and NaN
+    assert by_le == {"1": 2, "2": 3, "4": 5, "+Inf": 7}
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def _mk_timeline(i: int, error: str | None = None) -> recorder_lib.Timeline:
+    tl = recorder_lib.Timeline("dispatch", labels={"chip": "0", "i": i})
+    root = tl.span("dispatch", start_ns=1000 * i)
+    tl.span("stage", start_ns=1000 * i + 10, end_ns=1000 * i + 20,
+            parent=root)
+    root.end(1000 * i + 100)
+    if error:
+        tl.fail(error)
+    return tl
+
+
+def test_recorder_ring_capacity_and_order():
+    rec = recorder_lib.FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record(_mk_timeline(i))
+    recent = rec.timelines()
+    assert len(recent) == 8
+    assert [t.labels["i"] for t in recent] == [str(i) for i in range(12, 20)]
+    snap = rec.snapshot()
+    assert snap["recorded_total"] == 20
+    json.dumps(snap)  # JSON-ready
+
+
+def test_recorder_pins_errors_past_wraparound():
+    """The offending timeline must survive however much healthy traffic
+    follows -- post-mortems don't race the ring."""
+    rec = recorder_lib.FlightRecorder(capacity=4)
+    rec.record(_mk_timeline(0, error="boom"))
+    for i in range(1, 50):
+        rec.record(_mk_timeline(i))
+    assert all(t.labels["i"] != "0" for t in rec.timelines())  # wrapped out
+    pinned = rec.pinned()
+    assert len(pinned) == 1
+    assert pinned[0].labels["i"] == "0"
+    assert pinned[0].error == "boom"
+    assert rec.snapshot()["pinned"][0]["error"] == "boom"
+
+
+def test_recorder_concurrent_writers():
+    rec = recorder_lib.FlightRecorder(capacity=64)
+    n_threads, per_thread = 8, 500
+
+    def hammer(k):
+        for i in range(per_thread):
+            rec.record(_mk_timeline(k * per_thread + i))
+
+    threads = [threading.Thread(target=hammer, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recent = rec.timelines()
+    assert len(recent) == 64
+    seqs = [t.seq for t in recent]
+    assert len(set(seqs)) == 64  # unique slots, no torn entries
+    assert rec.snapshot()["recorded_total"] == n_threads * per_thread
+
+
+def test_recorder_event_and_tracez_summary():
+    rec = recorder_lib.FlightRecorder(capacity=16)
+    for i in range(5):
+        rec.record(_mk_timeline(i))
+    rec.record_event("watchdog_restart", stage="collector",
+                     error="collector died")
+    summ = rec.summary()
+    assert summ["spans"]["dispatch"]["count"] == 5
+    assert summ["spans"]["stage"]["count"] == 5
+    assert summ["spans"]["watchdog_restart"]["errors"] == 1
+    assert rec.pinned()[0].name == "watchdog_restart"
+    # duration buckets account for every closed span
+    stage_row = summ["spans"]["stage"]
+    assert sum(stage_row["latency_ms_le"].values()) == 5
+
+
+def test_debug_spans_endpoint_serves_recorder_json():
+    rec = recorder_lib.FlightRecorder(capacity=8)
+    rec.record(_mk_timeline(3))
+    reg = MetricsRegistry()
+    srv = exposition.MetricsServer(0, reg, host="127.0.0.1",
+                                   flight_recorder=rec).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/debug/spans", timeout=5) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            payload = json.loads(r.read())
+        assert payload["recent"][0]["labels"]["i"] == "3"
+        spans = payload["recent"][0]["spans"]
+        assert spans[0]["name"] == "dispatch"
+        assert spans[1]["parent_id"] == spans[0]["span_id"]
+        with urllib.request.urlopen(f"{base}/debug/tracez", timeout=5) as r:
+            summ = json.loads(r.read())
+        assert summ["spans"]["dispatch"]["count"] == 1
+    finally:
+        srv.stop()
+
+
+def test_dispatcher_records_timelines_and_pins_failures():
+    """The live BatchDispatcher records one nested, chip-labeled timeline
+    per dispatch into its recorder, and a failing dispatch's timeline is
+    pinned with the error."""
+    from robotic_discovery_platform_tpu.serving.batching import (
+        BatchDispatcher,
+    )
+
+    rec = recorder_lib.FlightRecorder(capacity=32)
+    calls = {"n": 0}
+
+    def flaky(frames, depths, intr, scales):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected launch failure")
+        return {"coverage": np.full((len(frames),), 1.0)}
+
+    d = BatchDispatcher(flaky, window_ms=2.0, max_batch=4,
+                        flight_recorder=rec)
+    frame = np.zeros((8, 8, 3), np.uint8)
+    depth = np.zeros((8, 8), np.uint16)
+    k = np.eye(3, dtype=np.float32)
+    try:
+        d.submit(frame, depth, k, 0.001)  # ok
+        with pytest.raises(RuntimeError, match="injected"):
+            d.submit(frame, depth, k, 0.001)  # launch fails
+        d.submit(frame, depth, k, 0.001)  # recovered
+    finally:
+        d.stop()
+    ok_tls = [t for t in rec.timelines() if t.error is None]
+    assert len(ok_tls) == 2
+    tl = ok_tls[0]
+    assert tl.labels["chip"] == "0"
+    assert tl.labels["bucket"] == "1"
+    assert tl.labels["mode"] == "single"
+    root = tl.root
+    names = [s.name for s in tl.spans]
+    for required in ("dispatch", "submit", "collect", "stage", "launch",
+                     "complete"):
+        assert required in names, names
+    for sp in tl.spans[1:]:
+        assert sp.parent_id == root.span_id  # one-level tree
+        assert sp.start_ns >= root.start_ns
+        assert sp.end_ns is not None and sp.end_ns <= root.end_ns
+    # the submit span carries the frame's trace context slot (None here:
+    # submitted outside any span)
+    (pinned,) = rec.pinned()
+    assert "injected launch failure" in pinned.error
+    assert pinned.root.end_ns is not None  # closed before recording
+
+
+# -- SLO tracking ------------------------------------------------------------
+
+
+def test_resolve_slo_ms(monkeypatch):
+    monkeypatch.delenv("RDP_SLO_MS", raising=False)
+    assert slo_lib.resolve_slo_ms(0.0) is None
+    assert slo_lib.resolve_slo_ms(50.0) == 50.0
+    monkeypatch.setenv("RDP_SLO_MS", "75")
+    assert slo_lib.resolve_slo_ms(0.0) == 75.0
+    monkeypatch.setenv("RDP_SLO_MS", "0")
+    assert slo_lib.resolve_slo_ms(50.0) is None
+
+
+def test_slo_tracker_counts_violations_and_burn():
+    reg = MetricsRegistry()
+    violations = reg.counter("v_total", "v", ("objective",))
+    burn = reg.gauge("b", "b", ("objective",))
+    objective = reg.gauge("o_seconds", "o", ("objective",))
+    t = slo_lib.SloTracker(
+        0.100, budget=0.1, window=10, name="e2e",
+        violations=violations.labels(objective="e2e"),
+        burn_gauge=burn.labels(objective="e2e"),
+        objective_gauge=objective.labels(objective="e2e"),
+    )
+    assert objective.labels(objective="e2e").value == pytest.approx(0.1)
+    for _ in range(8):
+        assert not t.observe(0.050)
+    assert t.observe(0.200)  # slow frame violates
+    assert t.observe(0.010, ok=False)  # failed frame always violates
+    assert t.violations_total == 2
+    assert violations.labels(objective="e2e").value == 2
+    # window of 10: 2 violations / 10 = 0.2 rate; budget 0.1 -> burn 2.0
+    assert t.violation_rate == pytest.approx(0.2)
+    assert t.burn == pytest.approx(2.0)
+    assert burn.labels(objective="e2e").value == pytest.approx(2.0)
+    # the window slides: 10 fast frames clear the burn
+    for _ in range(10):
+        t.observe(0.01)
+    assert t.burn == 0.0
+    assert t.violations_total == 2  # totals never reset
+    with pytest.raises(ValueError):
+        slo_lib.SloTracker(0.0)
+
+
+# -- open-loop harness helpers ----------------------------------------------
+
+
+def test_poisson_arrivals_shape():
+    rng = np.random.default_rng(0)
+    arr = bench_load.poisson_arrivals(100.0, 10.0, rng)
+    assert arr == sorted(arr)
+    assert all(0 < t < 10.0 for t in arr)
+    # rate check, generous bounds (Poisson sd ~ sqrt(1000) ~ 32)
+    assert 800 < len(arr) < 1200
+
+
+def test_trace_arrivals_replay(tmp_path):
+    p = tmp_path / "gaps.json"
+    p.write_text("[10, 20, 30]")  # ms gaps
+    arr = bench_load.trace_arrivals(str(p))
+    assert arr == pytest.approx([0.010, 0.030, 0.060])
+    (tmp_path / "bad.json").write_text("{}")
+    with pytest.raises(ValueError):
+        bench_load.trace_arrivals(str(tmp_path / "bad.json"))
+
+
+def test_summarize_level_percentiles_and_violations():
+    lat = [10.0] * 90 + [100.0] * 9 + [1000.0]
+    row = bench_load.summarize_level(lat, errors=2, offered_rps=50.0,
+                                     wall_s=2.0, slo_ms=50.0)
+    assert row["n"] == 100 and row["arrivals"] == 102
+    assert row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"] <= row["p999_ms"]
+    assert 100.0 < row["p999_ms"] <= 1000.0  # interpolated toward the max
+    # 10 samples over 50 ms + 2 errors = 12 violations of 102 arrivals
+    assert row["violations"] == 12
+    assert row["violation_rate"] == pytest.approx(12 / 102, abs=1e-4)
+    assert row["goodput_rps"] == pytest.approx(50.0)
+    empty = bench_load.summarize_level([], errors=0, offered_rps=1.0,
+                                       wall_s=1.0, slo_ms=None)
+    assert empty["p99_ms"] is None and "violation_rate" not in empty

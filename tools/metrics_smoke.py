@@ -27,12 +27,32 @@ REQUIRED_FAMILIES = (
     "rdp_stage_latency_seconds",
     "rdp_batch_queue_depth",
     "rdp_breaker_state",
+    # streaming-quantile summaries + SLO families (PR 6)
+    "rdp_stage_latency_summary_seconds",
+    "rdp_frame_latency_summary_seconds",
+    "rdp_slo_objective_seconds",
+    "rdp_slo_violations_total",
+    "rdp_slo_error_budget_burn",
 )
 REQUIRED_SAMPLES = (
     'rdp_stage_latency_seconds_count{stage="total"}',
     'rdp_frames_total{status="',
     'rdp_breaker_state{breaker="registry:',
+    'rdp_stage_latency_summary_seconds{stage="total",quantile="0.5"}',
+    'rdp_frame_latency_summary_seconds{quantile="0.99"}',
+    'rdp_slo_objective_seconds{objective="e2e"}',
+    'rdp_slo_error_budget_burn{objective="e2e"}',
 )
+
+
+def quantile_values(text: str, family: str) -> dict[str, float]:
+    """{quantile: value} samples of an unlabeled summary family."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith(f'{family}{{quantile="'):
+            key, value = line.rsplit(" ", 1)
+            out[key.split('"')[1]] = float(value)
+    return out
 
 
 def scrape(port: int) -> str:
@@ -92,6 +112,7 @@ def main() -> int:
         metrics_flush_every=1,
         calibration_path=str(tmp / "missing.npz"),
         metrics_port=-1,  # RDP_METRICS_PORT (set by CI) overrides this
+        slo_ms=250.0,  # SLO tracking on, so the rdp_slo_* families render
     )
     server, servicer = server_lib.build_server(cfg)
     port = server.add_insecure_port("localhost:0")
@@ -122,9 +143,17 @@ def main() -> int:
         print("---- scraped payload ----")
         print(text)
         return 1
+    # summary quantiles must be structurally monotone: exposition clamps
+    # the independent P^2 estimators to non-decreasing order
+    q = quantile_values(text, "rdp_frame_latency_summary_seconds")
+    ladder = [q[k] for k in ("0.5", "0.95", "0.99", "0.999")]
+    if ladder != sorted(ladder) or not all(v > 0 for v in ladder):
+        print(f"FAIL: frame-latency quantiles not positive-monotone: {q}")
+        return 1
     n_lines = len(text.strip().splitlines())
     print(f"OK: scraped {n_lines} exposition lines; all "
-          f"{len(REQUIRED_FAMILIES)} required families present")
+          f"{len(REQUIRED_FAMILIES)} required families present; "
+          f"p50={ladder[0]*1e3:.1f}ms <= p99.9={ladder[-1]*1e3:.1f}ms")
     return 0
 
 
