@@ -20,16 +20,31 @@ Results go to ``LOADBENCH.json`` (one row per offered-load level) and the
 driver contract from bench.py holds: exactly ONE JSON summary line on
 stdout, structured errors instead of tracebacks.
 
+Overload-control comparison (PR 7): ``--controller {off,on,both}`` runs
+the same offered-load ladder against a server with the overload control
+plane off (FIFO admission, no reactive controller -- the PR 2 behavior)
+and/or on (deadline-aware admission + the serving/controller.py reactive
+tuner), tagging every LOADBENCH.json row with its leg. Loads may be
+given relative to measured capacity (``--loads 0.75x,1.75x``: a short
+closed-loop burst measures capacity first), which is how the policy is
+validated open-loop at a known overload factor instead of by closed-loop
+FPS. ``--deadline-ms`` puts a real per-request gRPC deadline on every
+arrival (default 2x the SLO) so deadline-aware shedding has deadlines to
+work with; ``--chips N`` boots the smoke server over N faked CPU mesh
+chips, which is how CI's quarantine leg drives ``serving.chip.<i>.
+dispatch`` faults through failover.
+
 Usage:
     python bench_load.py --smoke                # self-hosted CPU server
     python bench_load.py --server host:50051 --loads 50,100,200
     python bench_load.py --smoke --trace gaps.json   # replay (ms gaps)
+    python bench_load.py --smoke --controller both --loads 0.75x,1.75x
 
 ``--smoke`` boots an in-process CPU server (tiny model, 64x64 frames,
 micro-batching on so the flight recorder and the ``serving.batch.*``
-fault sites are exercised) and is what CI's ``load-smoke`` job runs --
-including under ``RDP_FAULTS=serving.batch.complete:exc:1``, where the
-injected D2H failure must surface as counted violations, never a crash.
+fault sites are exercised) and is what CI's ``load-smoke`` and
+``overload-smoke`` jobs run -- including under fault injection, where
+injected failures must surface as counted violations, never a crash.
 """
 
 from __future__ import annotations
@@ -121,6 +136,53 @@ def trace_arrivals(path: str) -> list[float]:
 # -- measurement -------------------------------------------------------------
 
 
+def parse_loads(spec: str) -> list[tuple[float, bool]]:
+    """Offered-load entries: plain frames/sec, or capacity multiples
+    suffixed ``x`` (``1.5x`` = 1.5 times the measured closed-loop
+    capacity). Returns (value, is_multiplier) pairs."""
+    out = []
+    for token in spec.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        if token.lower().endswith("x"):
+            out.append((float(token[:-1]), True))
+        else:
+            out.append((float(token), False))
+    if not out:
+        raise ValueError(f"no loads in {spec!r}")
+    return out
+
+
+def measure_capacity(stub, request, seconds: float = 2.0,
+                     streams: int = 4) -> float:
+    """Closed-loop capacity estimate: ``streams`` workers each fire
+    one-frame requests back-to-back for ``seconds``; capacity is the
+    aggregate completed ok/sec. Used to anchor ``Nx`` offered loads at a
+    known overload factor."""
+    stop_t = time.perf_counter() + seconds
+    counts = [0] * streams
+
+    def worker(i: int) -> None:
+        while time.perf_counter() < stop_t:
+            try:
+                for resp in stub.AnalyzeActuatorPerformance(iter([request])):
+                    if not resp.status.startswith("ERROR"):
+                        counts[i] += 1
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(streams)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return sum(counts) / wall if wall > 0 else 0.0
+
+
 def summarize_level(lat_ms: list[float], errors: int, offered_rps: float,
                     wall_s: float, slo_ms: float | None) -> dict:
     """One LOADBENCH.json row: tail percentiles + violation rate +
@@ -148,12 +210,15 @@ def summarize_level(lat_ms: list[float], errors: int, offered_rps: float,
     return row
 
 
-def run_level(stub, request, arrivals: list[float],
-              workers: int) -> tuple[list[float], int, float]:
+def run_level(stub, request, arrivals: list[float], workers: int,
+              deadline_s: float | None = None
+              ) -> tuple[list[float], int, float]:
     """Fire one offered-load level: every arrival opens a one-frame
     stream at its scheduled time (late workers start late and the delay
     COUNTS -- latency is measured from the scheduled arrival, the
-    open-loop discipline that makes queueing visible)."""
+    open-loop discipline that makes queueing visible). ``deadline_s``
+    puts a real gRPC deadline on each request, so server-side
+    deadline-aware shedding sees the budget the client actually has."""
     lat_ms: list[float] = []
     errors = 0
     lock = threading.Lock()
@@ -168,7 +233,8 @@ def run_level(stub, request, arrivals: list[float],
         ok = False
         try:
             status = None
-            for resp in stub.AnalyzeActuatorPerformance(iter([request])):
+            for resp in stub.AnalyzeActuatorPerformance(
+                    iter([request]), timeout=deadline_s):
                 status = resp.status
             ok = status is not None and not status.startswith("ERROR")
         except Exception:
@@ -190,16 +256,24 @@ def run_level(stub, request, arrivals: list[float],
 # -- smoke server ------------------------------------------------------------
 
 
-def boot_smoke_server(slo_ms: float):
+def boot_smoke_server(slo_ms: float, controller: bool = False,
+                      chips: int = 1):
     """An in-process CPU server shaped like tools/metrics_smoke.py's:
     tiny registered model, micro-batching ON (so the dispatcher, the
     flight recorder, and the serving.batch.* fault sites are all in the
-    measured path), metrics endpoint on an ephemeral port."""
+    measured path), metrics endpoint on an ephemeral port.
+
+    ``controller=True`` boots the full overload control plane
+    (deadline-aware admission + the reactive controller, tightened to
+    smoke-scale time constants); False boots the control-off comparison
+    leg (FIFO admission, static knobs -- the PR 2 behavior). ``chips``
+    routes the dispatch window across that many faked CPU mesh chips
+    (the quarantine leg's topology)."""
     from robotic_discovery_platform_tpu.utils.platforms import (
         force_cpu_platform,
     )
 
-    force_cpu_platform(min_devices=1)
+    force_cpu_platform(min_devices=8 if chips > 1 else 1)
 
     import jax
 
@@ -240,6 +314,22 @@ def boot_smoke_server(slo_ms: float):
         metrics_port=-1,
         reload_poll_s=0.0,
         slo_ms=slo_ms,
+        # burn must react within a few-second smoke level -- and with a
+        # 128-frame window a 1% budget would let two slow frames read as
+        # "objective breached"; 5% keeps the smoke's brownout trigger at
+        # real overload, not scheduler noise
+        slo_window=128,
+        slo_budget=0.05,
+        serving_mesh=chips if chips > 1 else 0,
+        # the comparison legs: full overload control plane vs the PR 2
+        # static/FIFO behavior
+        admission_policy="deadline" if controller else "fifo",
+        controller_enabled=controller,
+        controller_interval_s=0.1,
+        controller_sustain_s=0.3,
+        controller_cooldown_s=0.5,
+        chip_breaker_failures=3 if controller or chips > 1 else 0,
+        chip_breaker_reset_s=2.0,
     )
     # no warmup_shape here on purpose: an armed serving.batch.complete
     # fault would fire inside build_server's warm-up frame and abort the
@@ -259,8 +349,27 @@ def main() -> None:
                         help="address of an already-running server "
                              "(host:port); mutually exclusive with --smoke")
     parser.add_argument("--loads", default=None,
-                        help="comma-separated offered loads in frames/sec "
+                        help="comma-separated offered loads in frames/sec, "
+                             "or capacity multiples suffixed 'x' (1.5x = "
+                             "1.5 times measured closed-loop capacity) "
                              "(default: 5,10,20 smoke / 50,100,200 full)")
+    parser.add_argument("--controller", choices=("off", "on", "both"),
+                        default="off",
+                        help="overload-control comparison legs: 'off' = "
+                             "FIFO admission + static knobs, 'on' = "
+                             "deadline admission + reactive controller, "
+                             "'both' = run both legs at the same loads "
+                             "(smoke only; rows are tagged per leg)")
+    parser.add_argument("--chips", type=int, default=1,
+                        help="smoke-server mesh width (faked CPU devices); "
+                             ">1 exercises multi-chip routing and the "
+                             "serving.chip.<i>.dispatch quarantine path")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="per-request gRPC deadline (default: the "
+                             "SLO itself -- a client with a 250ms "
+                             "objective gives up at 250ms) -- the budget "
+                             "deadline-aware shedding works against; 0 "
+                             "disables")
     parser.add_argument("--duration", type=float, default=None,
                         help="seconds per load level (default: 2.5 smoke "
                              "/ 20 full)")
@@ -284,6 +393,12 @@ def main() -> None:
     cli = parser.parse_args()
     if not cli.smoke and not cli.server:
         parser.error("one of --smoke or --server is required")
+    if cli.controller == "both" and not cli.smoke:
+        parser.error("--controller both boots one server per leg; it "
+                     "needs --smoke")
+    if cli.chips > 1 and not cli.smoke:
+        parser.error("--chips shapes the smoke server; it needs --smoke")
+    legs = ["off", "on"] if cli.controller == "both" else [cli.controller]
 
     import grpc
 
@@ -295,75 +410,118 @@ def main() -> None:
     slo_ms = (cli.slo_ms if cli.slo_ms is not None
               else float(env_slo) if env_slo
               else (250.0 if cli.smoke else 50.0))
-    loads = ([float(x) for x in cli.loads.split(",")] if cli.loads
-             else ([5.0, 10.0, 20.0] if cli.smoke
-                   else [50.0, 100.0, 200.0]))
+    load_spec = (parse_loads(cli.loads) if cli.loads
+                 else [(v, False) for v in
+                       ([5.0, 10.0, 20.0] if cli.smoke
+                        else [50.0, 100.0, 200.0])])
+    needs_capacity = any(mult for _, mult in load_spec)
     duration = cli.duration or (2.5 if cli.smoke else 20.0)
     if cli.frame_size:
         w = h = cli.frame_size
     else:
         w, h = (64, 64) if cli.smoke else (640, 480)
-
-    server = servicer = None
-    if cli.smoke:
-        server, servicer, address = boot_smoke_server(slo_ms)
-    else:
-        address = cli.server
+    deadline_ms = (cli.deadline_ms if cli.deadline_ms is not None
+                   else slo_ms)
+    deadline_s = deadline_ms / 1e3 if deadline_ms > 0 else None
 
     rng = np.random.default_rng(cli.seed)
-    source = SyntheticSource(width=w, height=h, seed=cli.seed, n_frames=1)
-    source.start()
-    color, depth = source.get_frames()
-    source.stop()
-    request = client_lib.encode_request(color, depth)
-
-    channel = grpc.insecure_channel(address)
-    stub = vision_grpc.VisionAnalysisServiceStub(channel)
-
+    request = None
     rows: list[dict] = []
+    legs_summary: dict[str, dict] = {}
+    capacity = None
     warm_errors = 0
-    try:
-        # warm phase, off the measured window: pays XLA compilation for
-        # the single-frame bucket and ABSORBS any armed one-shot fault
-        # (CI's graceful-degradation leg) -- errors are counted, not fatal
-        for _ in range(3):
-            try:
-                resps = list(stub.AnalyzeActuatorPerformance(iter([request])))
-                if any(r.status.startswith("ERROR") for r in resps):
-                    warm_errors += 1
-            except Exception:
-                warm_errors += 1
-        if servicer is not None:
-            # pre-compile every reachable batch bucket so the measured
-            # tail reflects serving, not one-off XLA compilation
-            servicer.warmup(w, h)
-
-        if cli.trace:
-            arrivals = trace_arrivals(cli.trace)
-            offered = (len(arrivals) / arrivals[-1]) if arrivals[-1] else 0.0
-            lat_ms, errors, wall = run_level(
-                stub, request, arrivals, cli.workers)
-            rows.append(summarize_level(lat_ms, errors, offered, wall,
-                                        slo_ms))
+    quarantines_total = 0
+    for leg in legs:
+        server = servicer = None
+        if cli.smoke:
+            server, servicer, address = boot_smoke_server(
+                slo_ms, controller=(leg == "on"), chips=cli.chips
+            )
         else:
-            for rate in loads:
-                arrivals = poisson_arrivals(rate, duration, rng)
-                if not arrivals:
-                    continue
-                lat_ms, errors, wall = run_level(
-                    stub, request, arrivals, cli.workers)
-                rows.append(summarize_level(lat_ms, errors, rate, wall,
-                                            slo_ms))
-                print(f"# offered={rate:.1f}rps n={len(lat_ms)} "
-                      f"errors={errors} "
-                      f"p50={rows[-1]['p50_ms']} p99={rows[-1]['p99_ms']}",
+            address = cli.server
+        channel = grpc.insecure_channel(address)
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        try:
+            if request is None:
+                source = SyntheticSource(width=w, height=h, seed=cli.seed,
+                                         n_frames=1)
+                source.start()
+                color, depth = source.get_frames()
+                source.stop()
+                request = client_lib.encode_request(color, depth)
+            # warm phase, off the measured window: pays XLA compilation
+            # for the single-frame bucket and ABSORBS any armed one-shot
+            # fault (CI's graceful-degradation leg) -- errors are
+            # counted, not fatal
+            for _ in range(3):
+                try:
+                    resps = list(
+                        stub.AnalyzeActuatorPerformance(iter([request]))
+                    )
+                    if any(r.status.startswith("ERROR") for r in resps):
+                        warm_errors += 1
+                except Exception:
+                    warm_errors += 1
+            if servicer is not None:
+                # pre-compile every reachable batch bucket so the
+                # measured tail reflects serving, not one-off XLA
+                # compilation
+                servicer.warmup(w, h)
+            if needs_capacity and capacity is None:
+                # anchor 'Nx' loads once, on the FIRST leg's server, so
+                # every leg sees the same absolute offered loads
+                capacity = measure_capacity(stub, request)
+                print(f"# measured capacity ~{capacity:.1f} rps",
                       file=sys.stderr)
-    finally:
-        channel.close()
-        if server is not None:
-            server.stop(grace=None)
-        if servicer is not None:
-            servicer.close()
+            loads = [v * capacity if mult else v for v, mult in load_spec]
+            leg_rows: list[dict] = []
+            if cli.trace:
+                arrivals = trace_arrivals(cli.trace)
+                offered = (len(arrivals) / arrivals[-1]
+                           if arrivals[-1] else 0.0)
+                lat_ms, errors, wall = run_level(
+                    stub, request, arrivals, cli.workers, deadline_s)
+                leg_rows.append(summarize_level(lat_ms, errors, offered,
+                                                wall, slo_ms))
+            else:
+                for rate in loads:
+                    arrivals = poisson_arrivals(rate, duration, rng)
+                    if not arrivals:
+                        continue
+                    lat_ms, errors, wall = run_level(
+                        stub, request, arrivals, cli.workers, deadline_s)
+                    leg_rows.append(summarize_level(lat_ms, errors, rate,
+                                                    wall, slo_ms))
+                    print(f"# leg={leg} offered={rate:.1f}rps "
+                          f"n={len(lat_ms)} errors={errors} "
+                          f"p50={leg_rows[-1]['p50_ms']} "
+                          f"p99={leg_rows[-1]['p99_ms']}",
+                          file=sys.stderr)
+            for row in leg_rows:
+                row["controller"] = leg
+            rows.extend(leg_rows)
+            top = leg_rows[-1] if leg_rows else {}
+            summary = {k: top.get(k) for k in (
+                "offered_rps", "p99_ms", "goodput_rps", "violation_rate",
+                "errors")}
+            if servicer is not None:
+                dispatcher = servicer.dispatcher
+                router = (dispatcher.router
+                          if dispatcher is not None else None)
+                summary["quarantines"] = (router.quarantines_total
+                                          if router is not None else 0)
+                quarantines_total += summary["quarantines"]
+                if servicer.controller is not None:
+                    summary["controller_actions"] = (
+                        servicer.controller.actions_total)
+                    summary["brownout_level"] = servicer.controller.level
+            legs_summary[leg] = summary
+        finally:
+            channel.close()
+            if server is not None:
+                server.stop(grace=None)
+            if servicer is not None:
+                servicer.close()
 
     import jax
 
@@ -374,8 +532,13 @@ def main() -> None:
         "arrivals": "trace" if cli.trace else "poisson",
         "smoke": bool(cli.smoke),
         "slo_ms": slo_ms,
+        "deadline_ms": deadline_ms,
         "workers": cli.workers,
         "frame": [w, h],
+        "chips": cli.chips,
+        "capacity_rps": (round(capacity, 3) if capacity is not None
+                         else None),
+        "legs": legs_summary,
         "rows": rows,
     }
     Path(cli.out).write_text(json.dumps(payload, indent=2) + "\n")
@@ -387,6 +550,7 @@ def main() -> None:
         "metric": "open_loop_tail_latency",
         "backend": jax.default_backend(),
         # headline: p99 at the highest offered load that was measured
+        # (the LAST leg's top row: the controller-on leg under 'both')
         "value": p99 if p99 is not None and math.isfinite(p99) else 0.0,
         "unit": "ms",
         "offered_rps": top.get("offered_rps", 0.0),
@@ -395,6 +559,10 @@ def main() -> None:
         "errors": total_errors,
         "warm_errors": warm_errors,
         "levels": len(rows),
+        "legs": legs_summary,
+        "capacity_rps": (round(capacity, 3) if capacity is not None
+                         else None),
+        "quarantines": quarantines_total,
         "out": cli.out,
         "smoke": bool(cli.smoke),
     })

@@ -133,6 +133,60 @@ BATCH_POOL_SIZE = REGISTRY.gauge(
     "here means a leak).",
 )
 
+# -- overload control (serving/admission.py + serving/controller.py) ---------
+
+SHED_BY_DEADLINE = REGISTRY.counter(
+    "rdp_shed_by_deadline_total",
+    "Frames shed by deadline-aware admission, by shed point: 'evicted' "
+    "(lost its backlog slot to a newer frame with more headroom), "
+    "'stale' (deadline unmeetable given the per-frame service-time "
+    "estimate; dropped before staging), 'abandoned' (submitter timed "
+    "out before the collector reached the frame).",
+    ("point",),
+)
+CONTROLLER_LEVEL = REGISTRY.gauge(
+    "rdp_controller_brownout_level",
+    "Reactive controller brownout ladder position: 0 normal, 1 batch "
+    "window shrunk + in-flight window halved, 2 shedding earlier at "
+    "admission, 3 refusing new streams.",
+)
+CONTROLLER_INFLIGHT = REGISTRY.gauge(
+    "rdp_controller_max_inflight",
+    "The in-flight-dispatch cap as currently tuned by the reactive "
+    "controller (AIMD around ServerConfig.max_inflight_dispatches).",
+)
+CONTROLLER_WINDOW_MS = REGISTRY.gauge(
+    "rdp_controller_window_ms",
+    "The batch window as currently tuned by the reactive controller.",
+)
+CONTROLLER_ACTIONS = REGISTRY.counter(
+    "rdp_controller_actions_total",
+    "Reactive controller actions taken, by action (inflight_up, "
+    "inflight_down, window_down, window_up, admission_tighten, "
+    "admission_relax, refuse_streams, accept_streams, floor_up, "
+    "floor_down, mode_sharded, mode_round_robin).",
+    ("action",),
+)
+
+# -- chip quarantine (serving/batching.DeviceRouter) -------------------------
+
+QUARANTINED_CHIPS = REGISTRY.gauge(
+    "rdp_quarantined_chips",
+    "Mesh chips currently quarantined (removed from the dispatch ring "
+    "by their per-chip circuit breaker; reinstated via half-open probe "
+    "dispatches).",
+)
+CHIP_QUARANTINES = REGISTRY.counter(
+    "rdp_chip_quarantines_total",
+    "Times each mesh chip entered quarantine.",
+    ("chip",),
+)
+CHIP_FAILOVER_FRAMES = REGISTRY.counter(
+    "rdp_chip_failover_frames_total",
+    "Frames requeued onto healthy chips after their dispatch failed on "
+    "a quarantining chip (each bounded to chips+1 attempts).",
+)
+
 # -- resilience --------------------------------------------------------------
 
 #: closed=0 / open=1 / half_open=2 (alert on `rdp_breaker_state == 1`).

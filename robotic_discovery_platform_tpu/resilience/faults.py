@@ -12,7 +12,12 @@ fire-drill) configure faults through the environment:
 Grammar: a comma-separated list of ``site:kind:count`` triples.
 
 - ``site``   the injection-point name (see ``fault_sites()`` for the
-             sites a process has actually hit).
+             sites a process has actually hit). ``*`` wildcards match
+             per-instance site families: ``serving.chip.*.dispatch``
+             arms every chip's dispatch site at once, while
+             ``serving.chip.1.dispatch`` kills exactly chip 1 -- the
+             quarantine/failover fire drill needs no code changes.
+             An exact entry for a site wins over any wildcard.
 - ``kind``   ``conn``   raise ``ConnectionError`` (transport refused),
              ``http500``/``http429`` raise :class:`InjectedHTTPError`
              with that status (server-side failure / throttling),
@@ -30,6 +35,7 @@ breaker stopped calling the registry).
 
 from __future__ import annotations
 
+import fnmatch
 import os
 import threading
 import time
@@ -93,6 +99,9 @@ class FaultRegistry:
             faults.setdefault(site, []).append(_Fault(site, kind, remaining))
         with self._lock:
             self._faults = faults
+            # wildcard specs (e.g. serving.chip.*.dispatch) are matched
+            # only when no exact entry exists for the concrete site
+            self._patterns = [s for s in faults if "*" in s]
             self._fired = {}
 
     def load_env(self) -> None:
@@ -109,8 +118,14 @@ class FaultRegistry:
             return
         with self._lock:
             self._visited.add(site)
+            configured = self._faults.get(site)
+            if configured is None:
+                for pattern in self._patterns:
+                    if fnmatch.fnmatchcase(site, pattern):
+                        configured = self._faults[pattern]
+                        break
             fault = None
-            for f in self._faults.get(site, ()):
+            for f in configured or ():
                 if f.remaining is None or f.remaining > 0:
                     fault = f
                     break

@@ -1,0 +1,205 @@
+"""Deadline-aware admission control for the batch dispatcher's backlog.
+
+PR 2 made the collector queue *bounded* (a submit arriving at the cap is
+shed instead of growing latency without bound), but the policy was blind:
+shed by queue position. Under overload that is exactly backwards -- the
+newcomer may have a generous deadline while a frame that has been queuing
+for most of its budget is already doomed; serving the doomed frame wastes
+device time that a meetable frame needed (InferLine's SLO-driven argument,
+PAPERS.md). This module makes the backlog deadline-aware:
+
+- every queued item carries an absolute ``deadline_t`` (monotonic seconds;
+  None = no deadline, infinite headroom);
+- :class:`DeadlineQueue.put` at the cap finds the queued item with the
+  LEAST remaining headroom and evicts it in favor of the newcomer -- but
+  only when the newcomer's headroom exceeds the evictee's by a margin
+  (the current service-time estimate): with homogeneous deadlines the
+  difference is queue-wait noise and the newcomer, last in, is shed
+  exactly as before ("fifo"-equivalent degenerate behavior);
+- :class:`ServiceTimeEstimator` keeps an EWMA of per-frame dispatch
+  service time so the collector can drop (error-complete) frames whose
+  deadline is already unmeetable *before* paying host staging + H2D +
+  device time for them -- shed work is work never staged.
+
+The queue is a drop-in for the dispatcher's ``queue.Queue`` surface
+(``get``/``get_nowait`` raise :class:`queue.Empty`, ``put(None)`` is the
+shutdown sentinel and bypasses the cap) plus ``requeue`` for chip-failover
+re-admission (already-admitted frames re-enter at the FRONT, keeping
+their place in deadline order, and never count against the cap).
+
+``policy="fifo"`` preserves the PR 2 behavior bit-for-bit (reject the
+newcomer at the cap, no eviction, no stale shedding margin) -- the
+controller-off leg of ``bench_load.py --controller both`` and any
+deployment that wants position-based shedding back.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+POLICIES = ("deadline", "fifo")
+
+
+class OverloadedError(RuntimeError):
+    """The dispatcher's backlog cap was hit; the frame was shed, not
+    queued. Retryable by the client (the server surfaces it as
+    RESOURCE_EXHAUSTED)."""
+
+
+class ServiceTimeEstimator:
+    """Per-frame service-time estimate (one frame's dispatch ride: host
+    staging through completed D2H), as the MINIMUM over a sliding window
+    of completed rides. The minimum, not a mean: shedding kills work
+    permanently, so the question admission must answer is "could this
+    frame make it even under best-case service?" -- and a best-case
+    bound is also robust to one-off spikes (an XLA compile riding a
+    dispatch once poisoned an EWMA here so badly that every later frame
+    looked unmeetable). Thread-safe. Zero until the first observation --
+    admission never sheds on a guess it has not earned."""
+
+    def __init__(self, window: int = 16):
+        self._lock = threading.Lock()
+        self._window: deque[float] = deque(maxlen=max(1, int(window)))
+        self._n = 0
+
+    def observe(self, seconds: float) -> None:
+        if seconds < 0:
+            return
+        with self._lock:
+            self._n += 1
+            self._window.append(float(seconds))
+
+    @property
+    def s(self) -> float:
+        """Best-case per-frame service time in seconds over the recent
+        window (0 = no observations yet)."""
+        with self._lock:
+            return min(self._window) if self._window else 0.0
+
+    @property
+    def observations(self) -> int:
+        with self._lock:
+            return self._n
+
+
+def headroom(item: Any, now: float) -> float:
+    """Seconds until ``item``'s deadline; inf when it carries none."""
+    deadline_t = getattr(item, "deadline_t", None)
+    if deadline_t is None:
+        return float("inf")
+    return deadline_t - now
+
+
+class DeadlineQueue:
+    """Bounded FIFO whose overflow policy understands deadlines.
+
+    Args:
+        max_backlog: queued-item cap (0 = every put at the cap sheds,
+            exactly the old bounded-queue semantics).
+        policy: "deadline" (least-headroom eviction at the cap) or
+            "fifo" (reject the newcomer at the cap, PR 2 behavior).
+        on_evict: called with each evicted item BEFORE the newcomer is
+            admitted (the dispatcher error-completes the evictee's
+            submitter here). Runs under the queue lock -- must not call
+            back into the queue.
+        clock: injectable monotonic clock for deterministic tests.
+    """
+
+    def __init__(self, max_backlog: int, policy: str = "deadline",
+                 on_evict: Callable[[Any], None] | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown admission policy {policy!r}; one of {POLICIES}"
+            )
+        self.max_backlog = int(max_backlog)
+        self.policy = policy
+        self._on_evict = on_evict
+        self._clock = clock
+        self._items: deque[Any] = deque()
+        self._cond = threading.Condition()
+        #: items shed by least-headroom eviction since construction
+        self.evictions = 0
+
+    def qsize(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def put(self, item: Any, margin_s: float = 0.0) -> None:
+        """Admit ``item``, evicting the least-headroom queued item when at
+        the cap (deadline policy) or raising :class:`OverloadedError`.
+
+        ``margin_s`` is the eviction hysteresis: the newcomer must beat
+        the evictee's headroom by at least this much (the caller passes
+        its service-time estimate), so FIFO-ordered frames with identical
+        budgets never churn. ``None`` is the shutdown sentinel and always
+        enqueues."""
+        with self._cond:
+            if item is not None and len(self._items) >= self.max_backlog:
+                evicted = self._pick_eviction(item, margin_s)
+                if evicted is None:
+                    raise OverloadedError(
+                        f"dispatcher backlog at cap ({self.max_backlog} "
+                        "frames queued); shedding load"
+                    )
+                self._items.remove(evicted)
+                self.evictions += 1
+                if self._on_evict is not None:
+                    self._on_evict(evicted)
+            self._items.append(item)
+            self._cond.notify()
+
+    def _pick_eviction(self, newcomer: Any, margin_s: float) -> Any | None:
+        """The queued item to shed in favor of ``newcomer``: the one with
+        the least remaining headroom, and only when the newcomer beats it
+        by ``margin_s``. None = shed the newcomer instead (fifo policy,
+        empty queue, or no queued item is meaningfully worse off)."""
+        if self.policy != "deadline" or not self._items:
+            return None
+        now = self._clock()
+        candidates = [i for i in self._items if i is not None]
+        if not candidates:
+            return None
+        worst = min(candidates, key=lambda i: headroom(i, now))
+        worst_headroom = headroom(worst, now)
+        if worst_headroom == float("inf"):
+            return None  # nobody carries a deadline: position sheds
+        if headroom(newcomer, now) > worst_headroom + max(margin_s, 1e-3):
+            return worst
+        return None
+
+    def requeue(self, items: list[Any]) -> None:
+        """Re-admit already-admitted items at the FRONT, preserving their
+        relative order, never counting against the cap (chip failover:
+        these frames hold submitters that are still waiting)."""
+        with self._cond:
+            for item in reversed(items):
+                self._items.appendleft(item)
+            self._cond.notify(len(items))
+
+    def get(self, timeout: float | None = None) -> Any:
+        """Pop the head; blocks (forever when ``timeout`` is None) and
+        raises :class:`queue.Empty` on timeout -- the ``queue.Queue``
+        contract the collector already speaks."""
+        with self._cond:
+            if timeout is None:
+                while not self._items:
+                    self._cond.wait()
+            else:
+                deadline = self._clock() + timeout
+                while not self._items:
+                    remaining = deadline - self._clock()
+                    if remaining <= 0:
+                        raise queue.Empty
+                    self._cond.wait(remaining)
+            return self._items.popleft()
+
+    def get_nowait(self) -> Any:
+        with self._cond:
+            if not self._items:
+                raise queue.Empty
+            return self._items.popleft()

@@ -96,6 +96,38 @@ into the bounded ring behind ``GET /debug/spans``. Failed dispatches and
 watchdog restarts are pinned so post-mortems never race the ring. The
 recorder only ever touches host-side ``monotonic_ns`` stamps: serial-mode
 (depth-1, 1-chip) results stay bit-identical with it enabled.
+
+Overload control (serving/admission.py + serving/controller.py):
+
+- the backlog is a :class:`~.admission.DeadlineQueue`: every submit
+  carries its absolute deadline into the queue, a put at the cap evicts
+  the queued frame with the least remaining headroom instead of blindly
+  rejecting the newcomer (``admission="fifo"`` restores position-based
+  shedding), and the collector drops frames whose deadline is already
+  unmeetable given the EWMA per-frame service-time estimate -- BEFORE
+  paying staging/H2D/device time (``rdp_shed_by_deadline_total``);
+- a submit that times out marks its frame *abandoned*; the collector
+  skips abandoned frames instead of staging device work for a caller
+  that already gave up (the PR 7 satellite bugfix);
+- the reactive controller (serving/controller.py) retunes
+  ``max_inflight``/``window_ms``/``bucket_floor``/dispatch mode online
+  through the ``set_*`` mutators below; every knob is read per dispatch,
+  so a change applies from the next launch with no restart. With the
+  controller enabled but idle (no actions), serial depth-1 results stay
+  bitwise identical -- every mutator is host-side scheduling state.
+
+Chip quarantine (:class:`DeviceRouter` with ``breaker_failures > 0``):
+each ring chip runs a per-chip :class:`~resilience.CircuitBreaker` over
+its dispatch outcomes. A chip whose breaker opens is *quarantined*:
+removed from the routing ring (``rdp_quarantined_chips``), its health
+entry flipped NOT_SERVING via ``on_health``, and its in-flight frames
+failed over to healthy chips (requeued at the queue front, bounded per
+frame) -- zero lost frames when the mesh has a healthy chip left. The
+last healthy chip is never quarantined. After ``breaker_reset_s`` the
+half-open breaker admits ONE probe dispatch; a completed probe closes
+the breaker and reinstates the chip (health back to SERVING). The
+per-chip fault sites ``serving.chip.<i>.dispatch`` (kinds exc/slow)
+make all of this drivable from ``RDP_FAULTS`` without code changes.
 """
 
 from __future__ import annotations
@@ -118,7 +150,16 @@ from robotic_discovery_platform_tpu.observability import (
 )
 from robotic_discovery_platform_tpu.ops import pipeline as pipeline_lib
 from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
-from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
+from robotic_discovery_platform_tpu.resilience import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    inject,
+)
+from robotic_discovery_platform_tpu.serving.admission import (
+    DeadlineQueue,
+    OverloadedError,
+    ServiceTimeEstimator,
+)
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -178,9 +219,28 @@ class DeviceRouter:
             closed over mesh-replicated variables) -- without them the
             dispatcher's shared analyzer is used on every chip, which is
             correct but re-transfers uncommitted weights per dispatch.
+        sharded_analyzer: optional mesh-replicated analyzer alongside
+            per-chip ``analyzers``: a router constructed round_robin
+            with this set can flip modes ONLINE (``set_mode``), which is
+            how the reactive controller picks round_robin vs sharded per
+            load level (the AlpaServe tradeoff).
+        breaker_failures / breaker_reset_s: per-chip quarantine circuit
+            breakers (0 disables quarantine -- the default, so direct
+            constructions keep PR 5 semantics). Only meaningful for
+            round_robin routing over > 1 chip; the sharded window spans
+            every chip in one dispatch and has no per-chip failure
+            domain.
+        on_health: ``(chip_index, serving: bool)`` callback invoked on
+            quarantine/reinstatement -- the serving layer flips the
+            ``rdp.serving.chip.<i>`` grpc.health.v1 entry here.
+        clock: injectable monotonic clock for the breakers (fake-clock
+            quarantine tests never sleep through reset timeouts).
     """
 
-    def __init__(self, mesh, mode: str = "round_robin", analyzers=None):
+    def __init__(self, mesh, mode: str = "round_robin", analyzers=None, *,
+                 sharded_analyzer=None, breaker_failures: int = 0,
+                 breaker_reset_s: float = 30.0, on_health=None,
+                 clock=time.monotonic):
         if mode not in DISPATCH_MODES:
             raise ValueError(
                 f"unknown dispatch mode {mode!r}; expected one of "
@@ -190,26 +250,162 @@ class DeviceRouter:
         self.mode = mode
         self.ring = mesh_lib.device_ring(mesh)
         self.analyzers = list(analyzers) if analyzers is not None else None
-        if self.analyzers is not None:
-            expected = 1 if mode == "sharded" else len(self.ring)
-            if len(self.analyzers) != expected:
+        self.sharded_analyzer = sharded_analyzer
+        if self.analyzers is not None and mode == "sharded":
+            # legacy shape: a sharded router takes its one mesh-replicated
+            # analyzer as a single-entry list
+            if len(self.analyzers) != 1:
                 raise ValueError(
-                    f"{mode} router over {len(self.ring)} chips expected "
-                    f"{expected} analyzer(s), got {len(self.analyzers)}"
+                    f"sharded router over {len(self.ring)} chips expected "
+                    f"1 analyzer(s), got {len(self.analyzers)}"
                 )
+            self.sharded_analyzer = self.analyzers[0]
+            self.analyzers = None
+        if self.analyzers is not None and len(self.analyzers) != len(self.ring):
+            raise ValueError(
+                f"{mode} router over {len(self.ring)} chips expected "
+                f"{len(self.ring)} analyzer(s), got {len(self.analyzers)}"
+            )
+        # built whenever the sharded layout is reachable (constructed
+        # sharded, or mode-switchable round_robin)
         self.sharding = (
-            mesh_lib.batch_sharding(mesh) if mode == "sharded" else None
+            mesh_lib.batch_sharding(mesh)
+            if mode == "sharded" or sharded_analyzer is not None
+            else None
         )
+        # -- chip quarantine state ------------------------------------------
+        self.quarantine_enabled = (
+            breaker_failures > 0 and mode == "round_robin"
+            and len(self.ring) > 1
+        )
+        self.on_health = on_health
+        self._qlock = threading.Lock()
+        self._quarantined: set[int] = set()
+        #: chips quarantined since construction (monotone; the gauge is
+        #: the live set size)
+        self.quarantines_total = 0
+        self.breakers: list[CircuitBreaker] = []
+        if self.quarantine_enabled:
+            self.breakers = [
+                CircuitBreaker(
+                    failure_threshold=breaker_failures,
+                    reset_timeout_s=breaker_reset_s,
+                    name=f"serving.chip.{i}", clock=clock,
+                )
+                for i in range(len(self.ring))
+            ]
 
     @property
     def chips(self) -> int:
         return len(self.ring)
 
+    @property
+    def can_switch_modes(self) -> bool:
+        """True when the controller may retarget round_robin vs sharded
+        online: requires the per-chip windows of a round_robin
+        construction plus a staged sharded layout."""
+        return self.sharding is not None and self.sharded_analyzer is not None
 
-class OverloadedError(RuntimeError):
-    """The dispatcher's backlog cap was hit; the frame was shed, not
-    queued. Retryable by the client (the server surfaces it as
-    RESOURCE_EXHAUSTED)."""
+    def set_mode(self, mode: str) -> None:
+        """Online dispatch-mode switch (controller actuator). Reads of
+        ``self.mode`` are per-dispatch, so the change applies from the
+        next launch; in-flight dispatches finish under their era's
+        placement."""
+        if mode not in DISPATCH_MODES:
+            raise ValueError(
+                f"unknown dispatch mode {mode!r}; expected one of "
+                f"{DISPATCH_MODES}"
+            )
+        if mode == self.mode:
+            return
+        if not self.can_switch_modes:
+            raise ValueError(
+                "router was not built mode-switchable (needs round_robin "
+                "construction with a sharded_analyzer)"
+            )
+        log.info("dispatch mode: %s -> %s", self.mode, mode)
+        self.mode = mode
+
+    # -- quarantine ----------------------------------------------------------
+
+    @property
+    def quarantined(self) -> frozenset[int]:
+        with self._qlock:
+            return frozenset(self._quarantined)
+
+    def healthy_chips(self) -> tuple[int, ...]:
+        with self._qlock:
+            return tuple(i for i in range(len(self.ring))
+                         if i not in self._quarantined)
+
+    def probe_candidate(self) -> int | None:
+        """A quarantined chip whose half-open breaker admits a probe NOW,
+        else None. The breaker holds the probe slot until the dispatch's
+        outcome is recorded, so at most one probe rides each chip."""
+        if not self.quarantine_enabled:
+            return None
+        with self._qlock:
+            quarantined = sorted(self._quarantined)
+        for i in quarantined:
+            if self.breakers[i].allow():
+                return i
+        return None
+
+    def record_result(self, chip: int, ok: bool,
+                      exc: BaseException | None = None) -> None:
+        """Feed one dispatch outcome on ``chip`` into its breaker and
+        apply the quarantine/reinstatement transition it implies."""
+        if not self.quarantine_enabled or not (0 <= chip < len(self.ring)):
+            return
+        breaker = self.breakers[chip]
+        if ok:
+            breaker.record_success()
+            with self._qlock:
+                reinstated = chip in self._quarantined
+                self._quarantined.discard(chip)
+                live = len(self._quarantined)
+            if reinstated:
+                obs.QUARANTINED_CHIPS.set(live)
+                log.info("chip %d reinstated after successful probe "
+                         "dispatch", chip)
+                if self.on_health is not None:
+                    self.on_health(chip, True)
+            return
+        with self._qlock:
+            already = chip in self._quarantined
+            last_healthy = (not already
+                            and len(self._quarantined) >= len(self.ring) - 1)
+        if last_healthy:
+            # never quarantine the last chip: a degraded mesh still
+            # serves; breaker state is left untouched so a recovered
+            # sibling's failure history cannot strand the ring empty
+            log.warning(
+                "chip %d dispatch failed (%s) but it is the last healthy "
+                "chip; not quarantining", chip,
+                exc if exc is not None else "unknown error",
+            )
+            return
+        breaker.record_failure(exc)
+        if breaker.state != "open":
+            return
+        with self._qlock:
+            newly = chip not in self._quarantined
+            self._quarantined.add(chip)
+            if newly:
+                self.quarantines_total += 1
+            live = len(self._quarantined)
+        if newly:
+            obs.QUARANTINED_CHIPS.set(live)
+            obs.CHIP_QUARANTINES.labels(chip=str(chip)).inc()
+            log.error(
+                "chip %d quarantined after repeated dispatch failures "
+                "(%s); failing its in-flight frames over to %d healthy "
+                "chip(s)", chip,
+                exc if exc is not None else "unknown error",
+                len(self.ring) - live,
+            )
+            if self.on_health is not None:
+                self.on_health(chip, False)
 
 
 @dataclass(eq=False)  # identity semantics: instances live in _pending sets
@@ -228,6 +424,17 @@ class _Pending:
     # when the frame entered the queue; the flight recorder's per-frame
     # "submit" span (queue + window wait) starts here
     submit_ns: int = field(default_factory=time.monotonic_ns)
+    # absolute monotonic deadline (submit timeout); admission orders
+    # evictions by remaining headroom against this, and the collector
+    # sheds the frame outright once it is unmeetable
+    deadline_t: float | None = None
+    # set by a submitter whose wait timed out: the caller is gone, so the
+    # collector must not stage device work for this frame
+    abandoned: bool = False
+    # times this frame was failed over to another chip after a dispatch
+    # failure (bounded per frame so a deterministic compute error cannot
+    # ricochet around the ring forever)
+    failovers: int = 0
 
 
 class _BucketBuffers:
@@ -264,6 +471,13 @@ class _Dispatch:
     # which routed chip (ring index) launched this dispatch; 0 for the
     # single-device and data-sharded windows
     chip: int = 0
+    # the dispatch mode at launch time ("single" without a router): mode
+    # switches mid-flight must not misattribute a sharded dispatch's
+    # outcome to chip 0's quarantine breaker
+    mode: str = "single"
+    # when host staging began (seconds); the completer derives the
+    # per-frame service-time estimate from staged_t -> completion
+    staged_t: float = 0.0
     # this dispatch's flight-recorder timeline + its root span; the
     # completer closes the root and records the timeline
     timeline: Any = None
@@ -307,6 +521,10 @@ class BatchDispatcher:
         router: optional :class:`DeviceRouter` spreading dispatches across
             a serving mesh. None (default) keeps today's single-device
             dispatch exactly.
+        admission: backlog overflow policy -- "deadline" (default: evict
+            the least-headroom queued frame at the cap, shed unmeetable
+            frames before staging) or "fifo" (PR 2's position-based
+            shedding, the overload-control-off comparison leg).
         flight_recorder: where per-dispatch span timelines are recorded
             (observability/recorder.py); defaults to the process-global
             ``RECORDER`` behind ``GET /debug/spans``. Tests inject a
@@ -319,6 +537,7 @@ class BatchDispatcher:
                  watchdog_interval_s: float = 1.0,
                  max_inflight: int = 2,
                  router: DeviceRouter | None = None,
+                 admission: str = "deadline",
                  flight_recorder: recorder_lib.FlightRecorder | None = None):
         self._analyze = analyze_batch
         self._recorder = (flight_recorder if flight_recorder is not None
@@ -329,7 +548,29 @@ class BatchDispatcher:
         self._submit_timeout_s = submit_timeout_s
         self._max_inflight = max(1, int(max_inflight))
         self._router = router
-        if router is not None and router.mode == "sharded":
+        #: best-case per-frame service time (staging -> completed D2H)
+        #: over a sliding window; the collector's unmeetable-deadline
+        #: shed consults this
+        self.service_estimate = ServiceTimeEstimator()
+        # liveness valve for the stale shed: after this many CONSECUTIVE
+        # stale sheds with no completed dispatch in between, the next
+        # frame is admitted regardless, so a stale estimate (or a pile
+        # of doomed frames) can never starve the signal that refreshes
+        # the estimate
+        self._sheds_since_complete = 0
+        #: multiplier on the service estimate when deciding a deadline is
+        #: unmeetable; the controller's brownout ladder raises it to shed
+        #: earlier at admission (level 2), 1.0 = only shed truly doomed
+        self.deadline_safety = 1.0
+        #: controller-tunable floor on the padded bucket size (1 = off);
+        #: see bucket_for
+        self.bucket_floor = 1
+        #: EWMA of recent dispatch sizes (frames per launch); the
+        #: controller's round_robin-vs-sharded choice keys off occupancy
+        self.recent_batch = 0.0
+        if router is not None and router.sharding is not None:
+            # the sharded layout is reachable (constructed sharded, or
+            # mode-switchable): its geometry must hold up front
             chips = router.chips
             if chips & (chips - 1):
                 raise ValueError(
@@ -348,7 +589,8 @@ class BatchDispatcher:
             self._n_windows = router.chips
         else:
             self._n_windows = 1
-        self._q: queue.Queue[_Pending | None] = queue.Queue()
+        self._q = DeadlineQueue(max_backlog, policy=admission,
+                                on_evict=self._on_evicted)
         self._cq: queue.Queue[_Dispatch | None] = queue.Queue()
         self._chip_slots = [
             threading.Semaphore(self._max_inflight)
@@ -423,12 +665,18 @@ class BatchDispatcher:
         """Block until this frame's analysis is available; returns the
         unbatched FrameAnalysis slice (host numpy leaves).
 
-        Raises :class:`OverloadedError` when the backlog cap is hit and
-        ``DeadlineExceeded`` when the result misses the submit deadline
-        (``timeout_s`` if given and tighter, else ``submit_timeout_s``).
+        Raises :class:`OverloadedError` when the backlog cap is hit (or
+        this frame was evicted at the cap by a newer frame with more
+        deadline headroom) and ``DeadlineExceeded`` when the result
+        misses the submit deadline (``timeout_s`` if given and tighter,
+        else ``submit_timeout_s``).
         """
+        timeout = self._submit_timeout_s
+        if timeout_s is not None:
+            timeout = min(timeout, timeout_s)
         p = _Pending(frame_rgb, depth, np.asarray(intrinsics, np.float32),
-                     float(depth_scale), trace_ctx=trace.current())
+                     float(depth_scale), trace_ctx=trace.current(),
+                     deadline_t=time.monotonic() + timeout)
         # enqueue under the lock stop() drains under: a submit either lands
         # BEFORE the drain (and is error-completed by it) or observes
         # stopped and raises -- it can never enqueue after the drain and
@@ -436,20 +684,23 @@ class BatchDispatcher:
         with self._submit_lock:
             if self._stopped.is_set():
                 raise RuntimeError("dispatcher stopped")
-            if self._q.qsize() >= self._max_backlog:
-                raise OverloadedError(
-                    f"dispatcher backlog at cap ({self._max_backlog} "
-                    "frames queued); shedding load"
-                )
             with self._pending_lock:
                 self._pending.add(p)
-            self._q.put(p)
+            try:
+                self._q.put(
+                    p, margin_s=self.service_estimate.s * self.deadline_safety
+                )
+            except OverloadedError:
+                with self._pending_lock:
+                    self._pending.discard(p)
+                raise
             obs.BATCH_QUEUE_DEPTH.set(self._q.qsize())
-        timeout = self._submit_timeout_s
-        if timeout_s is not None:
-            timeout = min(timeout, timeout_s)
         try:
             if not p.done.wait(timeout):
+                # the caller is giving up: flag the frame so the collector
+                # never stages device work for it (it may already be in
+                # flight, in which case its result is simply dropped)
+                p.abandoned = True
                 raise DeadlineExceeded(
                     f"batched analysis not ready within {timeout:.2f}s "
                     "(per-submit deadline)"
@@ -460,6 +711,19 @@ class BatchDispatcher:
         if p.error is not None:
             raise p.error
         return p.result
+
+    def _on_evicted(self, p: _Pending) -> None:
+        """DeadlineQueue eviction callback: error-complete the queued
+        frame that lost its slot to a newer frame with more headroom.
+        Runs under the queue lock -- only completes and counts."""
+        p.error = OverloadedError(
+            "frame evicted at the backlog cap: least remaining deadline "
+            "headroom; shedding load"
+        )
+        p.done.set()
+        obs.SHED_BY_DEADLINE.labels(point="evicted").inc()
+        with self._pending_lock:
+            self._pending.discard(p)
 
     def stop(self) -> None:
         """Idempotent. Every pending or racing submit is completed: frames
@@ -505,6 +769,60 @@ class BatchDispatcher:
         for p in stranded:
             p.error = exc
             p.done.set()
+
+    # -- controller actuators ------------------------------------------------
+    # Every knob here is host-side scheduling state read per dispatch, so
+    # an online retune applies from the next launch and an idle controller
+    # changes nothing -- serial depth-1 parity stays bitwise.
+
+    @property
+    def router(self) -> DeviceRouter | None:
+        return self._router
+
+    @property
+    def max_inflight(self) -> int:
+        return self._max_inflight
+
+    def set_max_inflight(self, n: int) -> None:
+        """Online in-flight-window retune (the controller's AIMD knob).
+        Rebuilds the per-window semaphores like a watchdog reset does;
+        dispatches already in flight hold (and release) their own slot
+        objects, so a shrink is honored from the next launch and the
+        window converges as the old era drains."""
+        n = max(1, int(n))
+        with self._inflight_lock:
+            if n == self._max_inflight:
+                return
+            old = self._max_inflight
+            self._max_inflight = n
+            self._pool_cap = n * self._n_windows + 1
+            self._chip_slots = [
+                threading.Semaphore(n) for _ in range(self._n_windows)
+            ]
+        log.info("max_inflight retuned: %d -> %d", old, n)
+
+    @property
+    def window_ms(self) -> float:
+        return self._window_s * 1e3
+
+    def set_window_ms(self, window_ms: float) -> None:
+        """Online batch-window retune; read once per collect cycle."""
+        self._window_s = max(0.0, float(window_ms)) / 1e3
+
+    def set_bucket_floor(self, floor: int) -> None:
+        """Online bucket-floor retune: pad dispatches up to at least this
+        bucket (amortizes per-dispatch overhead when the backlog is deep;
+        1 = off). Clamped to max_batch by bucket_for."""
+        self.bucket_floor = max(1, int(floor))
+
+    def set_deadline_safety(self, factor: float) -> None:
+        """How conservatively the collector sheds against the service
+        estimate (brownout level 2 raises this to shed earlier)."""
+        self.deadline_safety = max(1.0, float(factor))
+
+    def backlog(self) -> int:
+        """Frames currently queued for the collector."""
+        return self._q.qsize()
 
     # -- watchdog ------------------------------------------------------------
 
@@ -582,10 +900,46 @@ class BatchDispatcher:
 
     # -- collector / stager side --------------------------------------------
 
+    def _admit(self, p: _Pending) -> bool:
+        """Whether a dequeued frame is still worth staging. Abandoned
+        frames (their submitter already timed out) are dropped silently;
+        frames whose deadline is unmeetable given the current per-frame
+        service-time estimate are error-completed NOW -- shed work is
+        work never staged, and the device time goes to a frame that can
+        still make it."""
+        if p.abandoned:
+            obs.SHED_BY_DEADLINE.labels(point="abandoned").inc()
+            with self._pending_lock:
+                self._pending.discard(p)
+            return False
+        if p.deadline_t is not None and self._q.policy == "deadline":
+            est = self.service_estimate.s * self.deadline_safety
+            slack = p.deadline_t - time.monotonic()
+            if est > 0 and slack < est:
+                if self._sheds_since_complete >= 8:
+                    # probe-through: admit this frame despite the verdict
+                    # so its ride refreshes the service estimate (the
+                    # completer resets the counter)
+                    return True
+                self._sheds_since_complete += 1
+                obs.SHED_BY_DEADLINE.labels(point="stale").inc()
+                self._fail_group([p], DeadlineExceeded(
+                    f"deadline unmeetable: ~{est * 1e3:.0f}ms estimated "
+                    f"service vs {slack * 1e3:.0f}ms headroom; shed "
+                    "before staging"
+                ), log_it=False)
+                with self._pending_lock:
+                    self._pending.discard(p)
+                return False
+        return True
+
     def _collect(self) -> list[_Pending]:
-        first = self._q.get()
-        if first is None:
-            return []
+        while True:
+            first = self._q.get()
+            if first is None:
+                return []
+            if self._admit(first):
+                break
         batch = [first]
         deadline = time.monotonic() + self._window_s
         while len(batch) < self._max_batch:
@@ -598,6 +952,8 @@ class BatchDispatcher:
                 break
             if item is None:
                 break
+            if not self._admit(item):
+                continue
             batch.append(item)
         return batch
 
@@ -647,10 +1003,32 @@ class BatchDispatcher:
 
     def _pick_chip(self) -> int:
         """The ring index the next dispatch launches on: the least-loaded
-        chip by current in-flight count, ties walking the ring from the
-        cursor (so an idle mesh round-robins and a skewed one heals)."""
+        HEALTHY chip by current in-flight count, ties walking the ring
+        from the cursor (so an idle mesh round-robins and a skewed one
+        heals). A quarantined chip whose half-open breaker admits a probe
+        takes the dispatch instead -- that dispatch IS the probe, and its
+        outcome decides reinstatement. Sharded dispatches always ride
+        window 0 (one window spanning every chip)."""
+        r = self._router
+        if r is not None and r.mode == "sharded":
+            return 0
         if self._n_windows == 1:
             return 0
+        if r is not None and r.quarantine_enabled:
+            probe = r.probe_candidate()
+            if probe is not None:
+                log.info("routing probe dispatch to quarantined chip %d",
+                         probe)
+                return probe
+            healthy = set(r.healthy_chips())
+            with self._inflight_lock:
+                loads = [
+                    self._chip_inflight[i] if i in healthy else float("inf")
+                    for i in range(self._n_windows)
+                ]
+                chip = mesh_lib.least_loaded(loads, self._rr_next)
+                self._rr_next = (chip + 1) % self._n_windows
+            return chip
         with self._inflight_lock:
             chip = mesh_lib.least_loaded(self._chip_inflight, self._rr_next)
             self._rr_next = (chip + 1) % self._n_windows
@@ -667,14 +1045,22 @@ class BatchDispatcher:
         return self._router.ring[chip]
 
     def _analyze_for(self, chip: int) -> Callable:
-        a = self._router.analyzers if self._router is not None else None
+        r = self._router
+        if r is None:
+            return self._analyze
+        if r.mode == "sharded":
+            return (r.sharded_analyzer if r.sharded_analyzer is not None
+                    else self._analyze)
+        a = r.analyzers
         return a[min(chip, len(a) - 1)] if a else self._analyze
 
     def bucket_for(self, n: int) -> int:
-        """The padded bucket a group of ``n`` frames dispatches as. Sharded
-        routing raises the floor to the chip count so every chip gets at
-        least one row (the constructor validated divisibility)."""
-        b = _bucket(n, self._max_batch)
+        """The padded bucket a group of ``n`` frames dispatches as, never
+        below the controller's ``bucket_floor``. Sharded routing raises
+        the floor to the chip count so every chip gets at least one row
+        (the constructor validated divisibility)."""
+        b = _bucket(max(n, min(self.bucket_floor, self._max_batch)),
+                    self._max_batch)
         if self._router is not None and self._router.mode == "sharded":
             b = min(max(b, self._router.chips), self._max_batch)
         return b
@@ -683,13 +1069,29 @@ class BatchDispatcher:
         """Compile + run the analyzer for this batch shape on EVERY routed
         placement, blocking until done: warm-up and hot-reload
         pre-compilation route through here so the first real frame on any
-        chip (or under the sharded layout) never pays XLA compilation."""
-        for chip in range(self._n_windows):
+        chip (or under the sharded layout) never pays XLA compilation.
+        A mode-switchable router warms BOTH layouts, so a controller mode
+        flip mid-burst never stalls on a compile."""
+        r = self._router
+        placements: list[tuple[Any, Callable]] = []
+        if r is not None and r.mode == "sharded":
+            placements.append((r.sharding, self._analyze_for(0)))
+        else:
+            for chip in range(self._n_windows):
+                placements.append(
+                    (self._placement(chip), self._analyze_for(chip))
+                )
+        if (r is not None and r.can_switch_modes
+                and len(frames) % r.chips == 0):
+            other = ((r.sharding, r.sharded_analyzer)
+                     if r.mode == "round_robin" else None)
+            if other is not None:
+                placements.append(other)
+        for device, analyze in placements:
             staged = pipeline_lib.stage_batch(
-                frames, depths, intrinsics, scales,
-                device=self._placement(chip),
+                frames, depths, intrinsics, scales, device=device
             )
-            jax.block_until_ready(self._analyze_for(chip)(*staged))
+            jax.block_until_ready(analyze(*staged))
 
     def _stage_group(self, group: list[_Pending], b: int):
         """Host-side staging: the padded [b, ...] batch arrays for a group.
@@ -745,10 +1147,12 @@ class BatchDispatcher:
         # at the earliest member frame's submit, per-frame "submit" spans
         # cover queue + window wait and carry each frame's trace ID
         first_submit_ns = min(p.submit_ns for p in group)
+        # mode snapshot: an online set_mode between launch and completion
+        # must not misattribute this dispatch's outcome
+        mode = self._router.mode if self._router is not None else "single"
         tl = recorder_lib.Timeline("dispatch", labels={
             "chip": str(chip),
-            "mode": (self._router.mode if self._router is not None
-                     else "single"),
+            "mode": mode,
         })
         root = tl.span("dispatch", start_ns=first_submit_ns)
         tl.span("collect", start_ns=first_submit_ns, end_ns=collected_ns,
@@ -764,8 +1168,14 @@ class BatchDispatcher:
         launched = False
         try:
             inject("serving.batch.dispatch")
+            # per-chip fault site: RDP_FAULTS="serving.chip.1.dispatch:
+            # exc:-1" (or the serving.chip.*.dispatch wildcard) kills or
+            # slows exactly one chip's dispatches -- the quarantine and
+            # failover drill, no code changes needed
+            inject(f"serving.chip.{chip}.dispatch")
             n = len(group)
             obs.BATCH_SIZE.observe(n)
+            self.recent_batch += 0.25 * (n - self.recent_batch)
             b = self.bucket_for(n)
             tl.labels["bucket"] = str(b)
             t0 = time.monotonic_ns()
@@ -802,6 +1212,7 @@ class BatchDispatcher:
             obs.CHIP_DISPATCHES.labels(chip=str(chip)).inc()
             obs.CHIP_FRAMES.labels(chip=str(chip)).inc(n)
             self._cq.put(_Dispatch(group, out, bufs, slot, t2 / 1e9, chip,
+                                   mode=mode, staged_t=t0 / 1e9,
                                    timeline=tl, root=root))
             launched = True
         except BaseException as exc:  # deliver, don't kill the collector
@@ -809,11 +1220,47 @@ class BatchDispatcher:
             # the error, record it (record() pins errored timelines)
             root.end()
             self._recorder.record(tl.fail(exc))
-            self._fail_group(group, exc)
+            self._dispatch_failed(group, chip, mode, exc)
             self._pool_put(bufs)
         finally:
             if not launched:
                 slot.release()
+
+    def _dispatch_failed(self, group: list[_Pending], chip: int, mode: str,
+                         exc: BaseException) -> None:
+        """A dispatch on ``chip`` failed (launch or completion): feed the
+        chip's quarantine breaker and fail the frames over to healthy
+        chips where possible -- a requeued frame rides the NEXT dispatch,
+        which the quarantine-aware ``_pick_chip`` routes away from the
+        failing chip once its breaker opens. Frames out of failover
+        budget (or abandoned, or under a non-quarantining router) get the
+        error, exactly the old behavior."""
+        r = self._router
+        if r is not None and mode == "round_robin":
+            r.record_result(chip, ok=False, exc=exc)
+        can_failover = (r is not None and r.quarantine_enabled
+                        and mode == "round_robin"
+                        and not self._stopped.is_set())
+        if not can_failover:
+            self._fail_group(group, exc)
+            return
+        retry, doomed = [], []
+        budget = r.chips + 1
+        for p in group:
+            if (p.done.is_set() or p.abandoned or p.failovers >= budget):
+                doomed.append(p)
+            else:
+                p.failovers += 1
+                retry.append(p)
+        if retry:
+            obs.CHIP_FAILOVER_FRAMES.inc(len(retry))
+            log.warning(
+                "failing %d frame(s) over from chip %d after %s: %s",
+                len(retry), chip, type(exc).__name__, exc,
+            )
+            self._q.requeue(retry)
+        if doomed:
+            self._fail_group(doomed, exc)
 
     # -- completer side -----------------------------------------------------
 
@@ -833,10 +1280,23 @@ class BatchDispatcher:
                 for i, p in enumerate(d.group):
                     p.result = jax.tree.map(lambda a, _i=i: a[_i], host)
                     p.done.set()
+                # one completed ride = one per-frame service-time sample
+                # (staging through D2H): what the admission shed and the
+                # eviction margin consult
+                if d.staged_t > 0:
+                    self.service_estimate.observe(
+                        time.monotonic() - d.staged_t
+                    )
+                self._sheds_since_complete = 0
+                if self._router is not None and d.mode == "round_robin":
+                    # a completed dispatch is the chip's success signal --
+                    # and a quarantined chip's successful PROBE, which
+                    # reinstates it
+                    self._router.record_result(d.chip, ok=True)
             except BaseException as exc:  # deliver, keep draining
                 if d.timeline is not None:
                     d.timeline.fail(exc)
-                self._fail_group(d.group, exc)
+                self._dispatch_failed(d.group, d.chip, d.mode, exc)
             finally:
                 done_ns = time.monotonic_ns()
                 done_t = done_ns / 1e9

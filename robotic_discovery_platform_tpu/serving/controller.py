@@ -1,0 +1,303 @@
+"""Reactive SLO controller: the platform's overload-control brain.
+
+PR 6 finished the measurement layer (streaming p50-p99.9 summaries, the
+``rdp_slo_error_budget_burn`` gauge, per-dispatch span timelines, the
+open-loop ``bench_load.py`` harness); ROADMAP's verdict was "the
+measurement layer is done; what remains is the controller itself". This
+module is that controller, in the InferLine mold: a *planner* chose the
+static config (``ServerConfig``), and this *reactive tuner* perturbs it
+online from the live signals, never waiting for a redeploy:
+
+- **AIMD in-flight window**: when burn is comfortably low and the backlog
+  shows unmet demand, ``max_inflight`` steps up by one (additive
+  increase) toward ``inflight_cap``; a sustained burn > ``burn_high``
+  halves it (multiplicative decrease) as part of brownout entry -- the
+  TCP-shaped response that converges instead of oscillating.
+- **Brownout ladder** (entered on sustained burn > ``burn_high``, exited
+  symmetrically on sustained burn < ``burn_low``):
+
+  1. shrink the batch window (cut coalescing delay) and halve the
+     in-flight window (cut queueing on the device);
+  2. shed earlier at admission (raise the dispatcher's
+     ``deadline_safety`` so the collector drops frames whose deadline is
+     merely *at risk*, not only the doomed ones);
+  3. refuse new streams (UNAVAILABLE at stream entry: clients fail over
+     to another replica instead of piling onto a breached objective).
+     The servicer duty-cycles the refusal (every other stream) so the
+     SLO signal keeps flowing and the symmetric exit stays reachable --
+     refusing everything would starve the burn gauge at its peak and
+     freeze the ladder at the top rung.
+
+- **Bucket-floor tuning**: a deep backlog raises the padded-bucket floor
+  (bigger dispatches amortize per-launch overhead when there is always
+  work waiting); an empty one lowers it back (no padding tax at low
+  load).
+- **round_robin vs sharded** (the AlpaServe tradeoff): when the recent
+  dispatch occupancy fills the mesh (EWMA batch >= chips), one big
+  sharded dispatch beats N small ones; when occupancy collapses below
+  half the mesh, per-chip round_robin wins. Only wired when the router
+  was built mode-switchable.
+
+Every decision passes **hysteresis** (the burn signal must hold beyond
+its threshold for ``sustain_s``; the band between ``burn_low`` and
+``burn_high`` is dead) and a **cooldown** (at most one action per
+``cooldown_s``), so the controller cannot flap: a single slow frame
+moves nothing, and an overload is answered by one rung at a time.
+
+Like resilience/, the controller is deterministic under test: ``clock``
+is injectable and ``tick()`` is the whole control law -- fake-clock
+units never sleep. ``start()`` runs ticks on a daemon thread for
+production. The controller only ever touches host-side scheduling knobs
+(it holds no device state), so enabled-but-idle it changes nothing:
+serial depth-1 parity stays bitwise.
+
+``ServerConfig.controller_enabled`` / ``RDP_CONTROLLER`` turn it on;
+serving/server.py wires the live signals (SLO tracker burn, dispatcher
+backlog) and actuators (the dispatcher's ``set_*`` surface plus the
+servicer's refuse-streams flag).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_CONTROLLER_ENV_VAR = "RDP_CONTROLLER"
+
+#: brownout ladder depth (level 0 = normal operation)
+MAX_LEVEL = 3
+
+
+def resolve_controller_enabled(configured: bool) -> bool:
+    """The effective controller switch: ``RDP_CONTROLLER`` (1/true/on)
+    when set, else the configured value."""
+    raw = os.environ.get(_CONTROLLER_ENV_VAR, "").strip().lower()
+    if raw:
+        return raw in ("1", "true", "yes", "on")
+    return bool(configured)
+
+
+class ReactiveController:
+    """One control loop over one dispatcher.
+
+    Args:
+        dispatcher: zero-arg callable returning the live
+            :class:`~.batching.BatchDispatcher` (or None while the engine
+            swaps) -- an indirection, because hot-reload replaces the
+            dispatcher under a running controller.
+        burn: zero-arg callable returning the current error-budget burn
+            (``SloTracker.burn``; > 1 means the objective is breached).
+        refuse_streams: called with True/False when the brownout ladder
+            reaches/leaves its top rung; None leaves rung 3 unused.
+        interval_s: tick period for the background thread.
+        burn_high / burn_low: hysteresis thresholds around burn = 1.
+        sustain_s: how long burn must hold beyond a threshold to count.
+        cooldown_s: minimum spacing between actions.
+        inflight_cap: AIMD ceiling on max_inflight.
+        samples: zero-arg callable returning how many frames the SLO
+            tracker has observed; until it reaches ``min_samples`` the
+            burn signal is treated as a dead band (one slow warm-up
+            frame in a near-empty sliding window reads as a huge burn
+            -- acting on it would brown out an idle server).
+        clock: injectable monotonic clock (fake-clock tests drive
+            ``tick()`` directly and never sleep).
+    """
+
+    def __init__(self, dispatcher: Callable[[], Any],
+                 burn: Callable[[], float],
+                 refuse_streams: Callable[[bool], None] | None = None,
+                 *, interval_s: float = 0.5,
+                 burn_high: float = 1.0, burn_low: float = 0.5,
+                 sustain_s: float = 1.0, cooldown_s: float = 2.0,
+                 inflight_cap: int = 8,
+                 samples: Callable[[], int] | None = None,
+                 min_samples: int = 32,
+                 clock: Callable[[], float] = time.monotonic):
+        if burn_low > burn_high:
+            raise ValueError(
+                f"burn_low ({burn_low}) must not exceed burn_high "
+                f"({burn_high}): the dead band between them is the "
+                "hysteresis"
+            )
+        self._dispatcher = dispatcher
+        self._burn = burn
+        self._refuse_streams = refuse_streams
+        self.interval_s = float(interval_s)
+        self.burn_high = float(burn_high)
+        self.burn_low = float(burn_low)
+        self.sustain_s = float(sustain_s)
+        self.cooldown_s = float(cooldown_s)
+        self.inflight_cap = max(1, int(inflight_cap))
+        self._samples = samples
+        self.min_samples = int(min_samples)
+        self._clock = clock
+        #: brownout ladder position (0 = normal)
+        self.level = 0
+        self.actions_total = 0
+        self._high_since: float | None = None
+        self._low_since: float | None = None
+        self._last_action = float("-inf")
+        # the pre-brownout knob values, captured on first escalation so a
+        # symmetric exit restores exactly what load found
+        self._base_window_ms: float | None = None
+        self._base_inflight: int | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        obs.CONTROLLER_LEVEL.set(0)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="slo-controller", daemon=True
+        )
+        self._thread.start()
+        log.info(
+            "reactive SLO controller started (tick %.2fs, burn "
+            "thresholds %.2f/%.2f, cooldown %.1fs)",
+            self.interval_s, self.burn_low, self.burn_high, self.cooldown_s,
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # a control bug must never kill the loop
+                log.exception("controller tick failed; continuing")
+
+    # -- the control law -----------------------------------------------------
+
+    def tick(self) -> str | None:
+        """One control evaluation; returns the action taken (for tests
+        and logs) or None."""
+        now = self._clock()
+        d = self._dispatcher()
+        burn = self._burn()
+        if (self._samples is not None
+                and self._samples() < self.min_samples):
+            # the sliding window is not statistically filled yet: one
+            # slow frame among a handful reads as an enormous burn
+            burn = float("nan")  # lands in the dead band below
+        # hysteresis bookkeeping: the dead band clears both timers
+        if burn > self.burn_high:
+            self._low_since = None
+            if self._high_since is None:
+                self._high_since = now
+        elif burn < self.burn_low:
+            self._high_since = None
+            if self._low_since is None:
+                self._low_since = now
+        else:
+            self._high_since = self._low_since = None
+        action = None
+        if d is not None and now - self._last_action >= self.cooldown_s:
+            sustained_high = (self._high_since is not None
+                              and now - self._high_since >= self.sustain_s)
+            sustained_low = (self._low_since is not None
+                             and now - self._low_since >= self.sustain_s)
+            if sustained_high and self.level < MAX_LEVEL:
+                action = self._escalate(d)
+            elif sustained_low and self.level > 0:
+                action = self._deescalate(d)
+            elif sustained_low:
+                action = self._tune_steady(d)
+            if action is not None:
+                self._last_action = now
+                self.actions_total += 1
+                # a rung (or tune) answered this excursion; the signal
+                # must re-sustain before the next action
+                self._high_since = self._low_since = None
+                obs.CONTROLLER_ACTIONS.labels(action=action).inc()
+                log.info("controller action: %s (burn %.2f, level %d)",
+                         action, burn, self.level)
+        if d is not None:
+            obs.CONTROLLER_INFLIGHT.set(d.max_inflight)
+            obs.CONTROLLER_WINDOW_MS.set(d.window_ms)
+        obs.CONTROLLER_LEVEL.set(self.level)
+        return action
+
+    def _escalate(self, d) -> str:
+        self.level += 1
+        if self.level == 1:
+            self._base_window_ms = d.window_ms
+            self._base_inflight = d.max_inflight
+            d.set_window_ms(max(0.5, d.window_ms / 2))
+            d.set_max_inflight(max(1, d.max_inflight // 2))
+            return "window_down"
+        if self.level == 2:
+            d.set_deadline_safety(2.0)
+            return "admission_tighten"
+        if self._refuse_streams is not None:
+            self._refuse_streams(True)
+            return "refuse_streams"
+        # no stream-refusal hook: rung 3 degenerates to holding rung 2
+        self.level = 2
+        d.set_deadline_safety(3.0)
+        return "admission_tighten"
+
+    def _deescalate(self, d) -> str:
+        if self.level == 3:
+            self.level = 2
+            if self._refuse_streams is not None:
+                self._refuse_streams(False)
+            return "accept_streams"
+        if self.level == 2:
+            self.level = 1
+            d.set_deadline_safety(1.0)
+            return "admission_relax"
+        self.level = 0
+        if self._base_window_ms is not None:
+            d.set_window_ms(self._base_window_ms)
+        if self._base_inflight is not None:
+            d.set_max_inflight(self._base_inflight)
+        return "window_up"
+
+    def _tune_steady(self, d) -> str | None:
+        """Level-0 optimization under a healthy burn signal: grow
+        throughput where the backlog shows demand, give back padding and
+        parallelism where it does not."""
+        backlog = d.backlog()
+        if backlog > 0 and d.max_inflight < self.inflight_cap:
+            d.set_max_inflight(d.max_inflight + 1)
+            return "inflight_up"
+        mode_action = self._tune_mode(d)
+        if mode_action is not None:
+            return mode_action
+        if backlog >= 2 * d.bucket_floor and backlog >= 2:
+            floor = min(d.bucket_floor * 2, d._max_batch)
+            if floor != d.bucket_floor:
+                d.set_bucket_floor(floor)
+                return "floor_up"
+        if backlog == 0 and d.bucket_floor > 1:
+            d.set_bucket_floor(d.bucket_floor // 2)
+            return "floor_down"
+        return None
+
+    def _tune_mode(self, d) -> str | None:
+        r = d.router
+        if r is None or not r.can_switch_modes:
+            return None
+        # occupancy hysteresis: full-mesh batches justify one sharded
+        # dispatch; below half the mesh, per-chip windows win
+        if r.mode == "round_robin" and d.recent_batch >= r.chips:
+            r.set_mode("sharded")
+            return "mode_sharded"
+        if r.mode == "sharded" and d.recent_batch <= r.chips / 2:
+            r.set_mode("round_robin")
+            return "mode_round_robin"
+        return None

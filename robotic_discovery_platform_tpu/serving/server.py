@@ -53,6 +53,23 @@ Observability (observability/ package):
   summaries (``rdp_*_summary_seconds``: P^2 p50/p95/p99/p99.9), and when
   ServerConfig.slo_ms / RDP_SLO_MS sets an objective every frame feeds
   the SLO tracker (``rdp_slo_violations_total``, error-budget burn).
+
+Overload control (serving/admission.py, serving/controller.py):
+
+- the dispatcher's backlog is deadline-aware: at the cap the queued
+  frame with the least remaining headroom is evicted (not the newcomer
+  blindly rejected), and frames whose deadline is unmeetable are shed
+  before staging (``rdp_shed_by_deadline_total``);
+- with ServerConfig.controller_enabled / RDP_CONTROLLER, a reactive
+  controller consumes the error-budget burn gauge and retunes
+  max_inflight / batch window / bucket floor / dispatch mode online,
+  with a brownout ladder under sustained burn > 1 whose top rung
+  refuses new streams (UNAVAILABLE -> clients fail over);
+- a mesh chip whose dispatches keep failing is quarantined by its
+  per-chip circuit breaker: removed from the ring, its
+  ``rdp.serving.chip.<i>`` health entry flips NOT_SERVING, in-flight
+  frames fail over to healthy chips, and a half-open probe dispatch
+  reinstates it on recovery.
 """
 
 from __future__ import annotations
@@ -82,7 +99,10 @@ from robotic_discovery_platform_tpu.resilience import (
     DeadlineExceeded,
     inject,
 )
-from robotic_discovery_platform_tpu.serving import health as health_lib
+from robotic_discovery_platform_tpu.serving import (
+    controller as controller_lib,
+    health as health_lib,
+)
 from robotic_discovery_platform_tpu.serving.batching import (
     OverloadedError,
     resolve_dispatch_mode,
@@ -206,6 +226,18 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             )
         #: devices the batch dispatcher routes across (1 = single-device)
         self.serving_chips = chips if self._serving_mesh is not None else 1
+        # resolved BEFORE the first engine build: a controller-enabled
+        # server binds BOTH routed layouts (per-chip replicas and the
+        # mesh-replicated copy) so the controller can flip dispatch modes
+        # online
+        self._controller_enabled = controller_lib.resolve_controller_enabled(
+            cfg.controller_enabled
+        )
+        # brownout rung 3: the controller flips this and _enter_stream
+        # refuses every other new stream (UNAVAILABLE -> clients fail
+        # over; the duty cycle keeps the SLO signal alive)
+        self._refusing_streams = False
+        self._brownout_tick = 0
         self._engine = self._make_engine(model, variables, version)
         self._warm_shape: tuple[int, int] | None = None
         self._reload_stop: threading.Event | None = None
@@ -265,6 +297,51 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             )
             log.info("SLO tracking: %.1f ms objective, %.2f%% budget",
                      slo_ms, 100 * cfg.slo_budget)
+        # Reactive SLO controller (serving/controller.py): consumes the
+        # tracker's burn signal and retunes the LIVE engine's dispatcher
+        # (the indirection follows hot-reload swaps). Needs an objective
+        # to control against and a dispatcher to actuate.
+        self.controller: controller_lib.ReactiveController | None = None
+        if (self._controller_enabled and self.slo is not None
+                and cfg.batch_window_ms > 0):
+            self.controller = controller_lib.ReactiveController(
+                dispatcher=lambda: self._engine.dispatcher,
+                burn=lambda: self.slo.burn,
+                refuse_streams=self._set_refuse_streams,
+                interval_s=cfg.controller_interval_s,
+                burn_high=cfg.controller_burn_high,
+                burn_low=cfg.controller_burn_low,
+                sustain_s=cfg.controller_sustain_s,
+                cooldown_s=cfg.controller_cooldown_s,
+                inflight_cap=cfg.controller_inflight_cap,
+                samples=lambda: self.slo.observed_total,
+            )
+            self.controller.start()
+        elif self._controller_enabled:
+            log.warning(
+                "controller enabled but idle: it needs slo_ms > 0 (got "
+                "%s) and batch_window_ms > 0 (got %s)",
+                cfg.slo_ms, cfg.batch_window_ms,
+            )
+
+    def _set_refuse_streams(self, refusing: bool) -> None:
+        """Controller brownout rung 3 actuator."""
+        if refusing != self._refusing_streams:
+            log.warning(
+                "overload brownout: %s new analysis streams",
+                "refusing" if refusing else "accepting",
+            )
+        self._refusing_streams = refusing
+
+    def _on_chip_health(self, chip: int, serving: bool) -> None:
+        """DeviceRouter quarantine hook: a quarantined chip's
+        ``rdp.serving.chip.<i>`` health entry goes NOT_SERVING so probes
+        and dashboards see the degraded mesh; reinstatement flips it
+        back."""
+        self.health.set(
+            f"rdp.serving.chip.{chip}",
+            health_lib.SERVING if serving else health_lib.NOT_SERVING,
+        )
 
     @property
     def variables(self):
@@ -330,6 +407,9 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 # mesh-replicated copy (sharded). Passing uncommitted
                 # variables would re-transfer the whole weight tree on
                 # every routed dispatch.
+                chips = self.serving_chips
+                analyzers = None
+                sharded_analyzer = None
                 if self.dispatch_mode == "round_robin":
                     analyzers = [
                         (lambda frames, depths, intr, scales, _v=v:
@@ -339,6 +419,22 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                             for d in mesh_lib.device_ring(self._serving_mesh)
                         )
                     ]
+                    # controller-enabled round_robin servers additionally
+                    # bind the mesh-replicated layout when the geometry
+                    # permits it, so the controller can flip to sharded
+                    # dispatch online (one extra replicated weight copy)
+                    if (self._controller_enabled
+                            and not (chips & (chips - 1))
+                            and cfg.max_batch >= chips
+                            and cfg.max_batch % chips == 0):
+                        v_repl = mesh_lib.shard_pytree(
+                            self._serving_mesh, variables
+                        )
+                        sharded_analyzer = (
+                            lambda frames, depths, intr, scales:
+                            batch_analyze(v_repl, frames, depths, intr,
+                                          scales)
+                        )
                 else:
                     v_repl = mesh_lib.shard_pytree(
                         self._serving_mesh, variables
@@ -349,7 +445,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                         )
                     ]
                 router = DeviceRouter(
-                    self._serving_mesh, self.dispatch_mode, analyzers
+                    self._serving_mesh, self.dispatch_mode, analyzers,
+                    sharded_analyzer=sharded_analyzer,
+                    breaker_failures=cfg.chip_breaker_failures,
+                    breaker_reset_s=cfg.chip_breaker_reset_s,
+                    on_health=self._on_chip_health,
                 )
             dispatcher = BatchDispatcher(
                 lambda frames, depths, intr, scales: batch_analyze(
@@ -364,6 +464,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     cfg.max_inflight_dispatches
                 ),
                 router=router,
+                admission=cfg.admission_policy,
             )
         return Engine(analyze, variables, dispatcher, version)
 
@@ -450,6 +551,15 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         with self._streams_cond:
             if self._draining or self._closed:
                 return False
+            if self._refusing_streams:
+                # brownout rung 3 duty-cycles: every other new stream is
+                # refused. Refusing ALL streams would starve the SLO
+                # signal (refused streams never observe a frame) and
+                # deadlock the ladder at its top rung; half keeps burn
+                # flowing so the symmetric exit stays reachable.
+                self._brownout_tick += 1
+                if self._brownout_tick % 2:
+                    return False
             self._active_streams += 1
         obs.INFLIGHT_STREAMS.inc()
         return True
@@ -468,8 +578,8 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
     def AnalyzeActuatorPerformance(self, request_iterator, context):
         if not self._enter_stream():
             context.abort(grpc.StatusCode.UNAVAILABLE,
-                          "server is draining; retry against another "
-                          "replica")
+                          "server is draining or in overload brownout; "
+                          "retry against another replica")
         # Adopt the client's trace: the stream runs inside a span whose
         # trace ID came over the wire (traceparent metadata), so client-
         # and server-side log lines for the same stream carry the same
@@ -785,14 +895,17 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                                          height=height),
         )
         color, depth = self._decode(req)
-        # exercise the real per-frame path once (decode included), then
         # pre-compile every graph a load burst could hit (single-frame or
-        # per-bucket batched -- shared with the hot-reload warm). Under the
-        # reload lock: otherwise a poll tick that read _warm_shape as None
-        # could swap in a never-warmed engine while we warm the old one.
-        self._analyze_frame(color, depth)
+        # per-bucket batched -- shared with the hot-reload warm) BEFORE
+        # exercising the real per-frame path: the exercise frame's
+        # dispatch ride feeds the admission service-time estimate, and a
+        # ride that pays XLA compilation would poison it (every early
+        # deadline would look unmeetable). Under the reload lock:
+        # otherwise a poll tick that read _warm_shape as None could swap
+        # in a never-warmed engine while we warm the old one.
         with self._reload_lock:
             self._warm_engine(self._engine)
+        self._analyze_frame(color, depth)
         # readiness flips ONLY here: a probe sees SERVING once the first
         # real frame path has compiled and run, never before
         self.mark_ready()
@@ -836,6 +949,8 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # flag first: an in-flight reload re-checks it before swapping, so
         # a generation built after this point never goes live
         self._closed = True
+        if self.controller is not None:
+            self.controller.stop()
         if self._reload_stop is not None:
             self._reload_stop.set()
         if self._reload_thread is not None:

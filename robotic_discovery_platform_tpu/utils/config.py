@@ -275,6 +275,44 @@ class ServerConfig:
     slo_budget: float = 0.01
     # Sliding-window length (frames) for the burn-rate estimate.
     slo_window: int = 512
+    # -- overload control (serving/admission.py, serving/controller.py) -----
+    # Backlog overflow policy: "deadline" evicts the queued frame with
+    # the least remaining deadline headroom when the cap is hit and sheds
+    # frames whose deadline is unmeetable BEFORE staging them; "fifo"
+    # restores position-based shedding (reject the newcomer at the cap).
+    admission_policy: str = "deadline"
+    # Reactive SLO controller (serving/controller.py): consumes the
+    # error-budget burn gauge to retune max_inflight / batch window /
+    # bucket floor / dispatch mode online, with a brownout ladder under
+    # sustained burn > 1. Needs slo_ms > 0 and batch_window_ms > 0. The
+    # RDP_CONTROLLER env var overrides this value.
+    controller_enabled: bool = False
+    # Controller tick period; every decision additionally passes the
+    # hysteresis (sustain) and cooldown gates below.
+    controller_interval_s: float = 0.5
+    # How long burn must hold beyond a threshold before it counts
+    # (single slow frames move nothing).
+    controller_sustain_s: float = 1.0
+    # Minimum spacing between controller actions (one brownout rung or
+    # one AIMD step at a time).
+    controller_cooldown_s: float = 2.0
+    # Hysteresis thresholds around burn = 1: escalate above high,
+    # de-escalate/tune below low, dead band between.
+    controller_burn_high: float = 1.0
+    controller_burn_low: float = 0.5
+    # AIMD ceiling for the controller's additive max_inflight increases.
+    controller_inflight_cap: int = 8
+    # -- chip quarantine (serving/batching.DeviceRouter) --------------------
+    # Per-chip dispatch circuit breaker: after this many consecutive
+    # dispatch failures on one mesh chip, that chip is quarantined
+    # (removed from the ring, health entry NOT_SERVING, in-flight frames
+    # failed over to healthy chips) until a half-open probe dispatch
+    # succeeds. 0 disables quarantine. The last healthy chip is never
+    # quarantined.
+    chip_breaker_failures: int = 3
+    # How long a quarantined chip fast-fails before a probe dispatch is
+    # routed to it.
+    chip_breaker_reset_s: float = 15.0
 
 
 @dataclass(frozen=True)

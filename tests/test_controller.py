@@ -1,0 +1,709 @@
+"""Overload control plane (PR 7): deadline-aware admission
+(serving/admission.py), the reactive SLO controller
+(serving/controller.py), and chip quarantine (serving/batching.
+DeviceRouter) -- fake-clock units with zero real sleeps for every control
+law, plus live-dispatcher integration and a chip-kill chaos test on a
+4-chip faked-CPU mesh (quarantine, zero lost frames after failover,
+reinstatement on recovery)."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+from robotic_discovery_platform_tpu.resilience import (
+    DeadlineExceeded,
+    configure_faults,
+    fired,
+)
+from robotic_discovery_platform_tpu.serving import (
+    admission as admission_lib,
+    batching as batching_lib,
+)
+from robotic_discovery_platform_tpu.serving.admission import (
+    DeadlineQueue,
+    OverloadedError,
+    ServiceTimeEstimator,
+)
+from robotic_discovery_platform_tpu.serving.batching import (
+    BatchDispatcher,
+    DeviceRouter,
+)
+from robotic_discovery_platform_tpu.serving.controller import (
+    ReactiveController,
+    resolve_controller_enabled,
+)
+
+_FRAME = np.zeros((8, 8, 3), np.uint8)
+_DEPTH = np.zeros((8, 8), np.uint16)
+_K = np.eye(3, dtype=np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    configure_faults(None)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class Item:
+    def __init__(self, deadline_t=None, name=""):
+        self.deadline_t = deadline_t
+        self.name = name
+
+
+# ---------------------------------------------------------------------------
+# DeadlineQueue admission
+# ---------------------------------------------------------------------------
+
+
+def test_fifo_policy_rejects_newcomer_at_cap():
+    q = DeadlineQueue(1, policy="fifo")
+    q.put(Item(deadline_t=1.0))
+    with pytest.raises(OverloadedError, match="shedding load"):
+        q.put(Item(deadline_t=99.0))
+    assert q.qsize() == 1 and q.evictions == 0
+
+
+def test_deadline_policy_evicts_least_headroom_for_roomier_newcomer():
+    clock = FakeClock(0.0)
+    evicted = []
+    q = DeadlineQueue(2, policy="deadline", on_evict=evicted.append,
+                      clock=clock)
+    doomed = Item(deadline_t=0.5, name="doomed")
+    q.put(doomed)
+    q.put(Item(deadline_t=30.0, name="mid"))
+    roomy = Item(deadline_t=60.0, name="roomy")
+    q.put(roomy)  # cap hit: the least-headroom frame loses its slot
+    assert [i.name for i in evicted] == ["doomed"]
+    assert q.evictions == 1
+    assert q.get().name == "mid" and q.get().name == "roomy"
+
+
+def test_deadline_policy_sheds_newcomer_when_it_has_least_headroom():
+    clock = FakeClock(0.0)
+    q = DeadlineQueue(1, policy="deadline", clock=clock)
+    q.put(Item(deadline_t=30.0))
+    with pytest.raises(OverloadedError, match="shedding load"):
+        q.put(Item(deadline_t=0.1))
+    # homogeneous deadlines: queue-order headroom differences are inside
+    # the margin, so the newcomer sheds exactly as the old FIFO did
+    with pytest.raises(OverloadedError):
+        q.put(Item(deadline_t=30.0), margin_s=1.0)
+    assert q.qsize() == 1
+
+
+def test_deadline_policy_without_deadlines_degenerates_to_fifo():
+    q = DeadlineQueue(1, policy="deadline")
+    q.put(Item())  # no deadline: infinite headroom, never evicted
+    with pytest.raises(OverloadedError):
+        q.put(Item(deadline_t=5.0))
+
+
+def test_requeue_reenters_at_front_and_ignores_cap():
+    q = DeadlineQueue(1, policy="deadline")
+    q.put(Item(name="a"))
+    q.requeue([Item(name="r1"), Item(name="r2")])
+    assert q.qsize() == 3  # failover re-admission never sheds
+    assert [q.get().name for _ in range(3)] == ["r1", "r2", "a"]
+
+
+def test_queue_sentinel_timeout_and_policy_validation():
+    q = DeadlineQueue(0, policy="deadline")
+    q.put(None)  # shutdown sentinel bypasses the cap
+    assert q.get() is None
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.01)
+    with pytest.raises(queue.Empty):
+        q.get_nowait()
+    with pytest.raises(ValueError, match="admission policy"):
+        DeadlineQueue(1, policy="bogus")
+
+
+def test_service_estimator_is_best_case_and_spike_robust():
+    est = ServiceTimeEstimator(window=4)
+    assert est.s == 0.0  # no observations: admission never sheds
+    est.observe(2.0)  # an XLA-compile-laden ride
+    assert est.s == 2.0
+    est.observe(0.01)
+    assert est.s == 0.01  # one healthy ride heals the estimate
+    for _ in range(4):
+        est.observe(0.05)
+    assert est.s == 0.05  # the spike aged out of the window
+    assert est.observations == 6
+
+
+# ---------------------------------------------------------------------------
+# dispatcher integration: eviction, stale shed, abandoned skip
+# ---------------------------------------------------------------------------
+
+
+def _gated_analyze(gate: threading.Event):
+    def analyze(frames, depths, intr, scales):
+        gate.wait(30.0)
+        return {"sum": np.asarray(
+            [int(f.reshape(-1).sum()) for f in np.asarray(frames)]
+        )}
+
+    return analyze
+
+
+def _submit_bg(d, outcomes, key, timeout_s, value=1):
+    def run():
+        try:
+            outcomes[key] = d.submit(
+                np.full((8, 8, 3), value, np.uint8), _DEPTH, _K, 0.001,
+                timeout_s=timeout_s)
+        except BaseException as exc:
+            outcomes[key] = exc
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
+def test_submit_eviction_error_completes_the_loser():
+    gate = threading.Event()
+    d = BatchDispatcher(_gated_analyze(gate), window_ms=1.0, max_batch=1,
+                        max_backlog=1, watchdog_interval_s=0.0)
+    try:
+        outcomes = {}
+        t_a = _submit_bg(d, outcomes, "a", 30.0)  # dispatched, gated
+        deadline = time.monotonic() + 10
+        while sum(d.chip_dispatches) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t_b = _submit_bg(d, outcomes, "b", 5.0)  # queued, 5s headroom
+        while d.backlog() < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # newcomer with far more headroom: b is evicted, c takes the slot
+        t_c = _submit_bg(d, outcomes, "c", 60.0)
+        t_b.join(timeout=10)
+        assert isinstance(outcomes["b"], OverloadedError)
+        assert "evicted" in str(outcomes["b"])
+        gate.set()
+        t_a.join(timeout=10)
+        t_c.join(timeout=10)
+        assert not isinstance(outcomes["a"], BaseException)
+        assert not isinstance(outcomes["c"], BaseException)
+    finally:
+        gate.set()
+        d.stop()
+
+
+def test_collector_sheds_unmeetable_deadline_before_staging():
+    d = BatchDispatcher(_gated_analyze(threading.Event()), window_ms=1.0,
+                        max_batch=1, watchdog_interval_s=0.0)
+    try:
+        d.service_estimate.observe(10.0)  # 10s per-frame service estimate
+        before = sum(d.chip_dispatches)
+        with pytest.raises(DeadlineExceeded, match="unmeetable"):
+            d.submit(_FRAME, _DEPTH, _K, 0.001, timeout_s=0.2)
+        assert sum(d.chip_dispatches) == before  # never staged
+    finally:
+        d.stop()
+
+
+def test_stale_shed_probe_through_refreshes_the_estimate():
+    gate = threading.Event()
+    gate.set()  # analyzer runs immediately: real rides are fast
+    d = BatchDispatcher(_gated_analyze(gate), window_ms=1.0, max_batch=1,
+                        watchdog_interval_s=0.0)
+    try:
+        d.service_estimate.observe(10.0)  # poisoned estimate
+        sheds = 0
+        ok = 0
+        for _ in range(12):
+            try:
+                d.submit(_FRAME, _DEPTH, _K, 0.001, timeout_s=0.5)
+                ok += 1
+                break
+            except DeadlineExceeded:
+                sheds += 1
+        # after at most 8 consecutive sheds a probe frame is admitted,
+        # its fast ride heals the estimate, and traffic flows again
+        assert ok == 1 and sheds <= 8
+        d.submit(_FRAME, _DEPTH, _K, 0.001, timeout_s=0.5)
+        assert d.service_estimate.s < 1.0
+    finally:
+        gate.set()
+        d.stop()
+
+
+def test_abandoned_frame_is_skipped_not_dispatched():
+    """Satellite bugfix: a submit that timed out used to leave its frame
+    queued; it was later staged and dispatched for a caller that had
+    already given up."""
+    gate = threading.Event()
+    d = BatchDispatcher(_gated_analyze(gate), window_ms=1.0, max_batch=1,
+                        max_inflight=1, watchdog_interval_s=0.0)
+    try:
+        abandoned_before = obs.SHED_BY_DEADLINE.labels(
+            point="abandoned").value
+        outcomes = {}
+        # a: dispatched and gated in flight; b: collected, blocked on a's
+        # in-flight slot -- so c stays IN THE QUEUE while it times out
+        t_a = _submit_bg(d, outcomes, "a", 30.0, value=1)
+        deadline = time.monotonic() + 10
+        while sum(d.chip_dispatches) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t_b = _submit_bg(d, outcomes, "b", 30.0, value=2)
+        while d.backlog() > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)  # b left the queue (collected, not launched)
+        with pytest.raises(DeadlineExceeded, match="per-submit deadline"):
+            d.submit(_FRAME, _DEPTH, _K, 0.001, timeout_s=0.05)
+        gate.set()
+        t_a.join(timeout=10)
+        t_b.join(timeout=10)
+        out = d.submit(np.full((8, 8, 3), 3, np.uint8), _DEPTH, _K, 0.001,
+                       timeout_s=10.0)
+        assert int(np.asarray(out["sum"])) == 8 * 8 * 3 * 3
+        # a, b, and the follow-up dispatched; the abandoned frame was
+        # skipped at collection and counted, never staged
+        assert sum(d.chip_frames) == 3
+        assert obs.SHED_BY_DEADLINE.labels(point="abandoned").value \
+            == abandoned_before + 1
+    finally:
+        gate.set()
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# reactive controller (fake clock, stub dispatcher -- zero sleeps)
+# ---------------------------------------------------------------------------
+
+
+class FakeRouter:
+    def __init__(self, chips=4, mode="round_robin", switchable=True):
+        self.chips = chips
+        self.mode = mode
+        self.can_switch_modes = switchable
+
+    def set_mode(self, mode):
+        self.mode = mode
+
+
+class FakeDispatcher:
+    def __init__(self, router=None):
+        self.max_inflight = 2
+        self._window_ms = 2.0
+        self.bucket_floor = 1
+        self.deadline_safety = 1.0
+        self.recent_batch = 0.0
+        self._max_batch = 8
+        self._backlog = 0
+        self.router = router
+
+    @property
+    def window_ms(self):
+        return self._window_ms
+
+    def set_window_ms(self, ms):
+        self._window_ms = ms
+
+    def set_max_inflight(self, n):
+        self.max_inflight = max(1, int(n))
+
+    def set_bucket_floor(self, floor):
+        self.bucket_floor = max(1, int(floor))
+
+    def set_deadline_safety(self, factor):
+        self.deadline_safety = max(1.0, float(factor))
+
+    def backlog(self):
+        return self._backlog
+
+
+def _controller(d, burn_box, clock, refuse=None, samples=None, **kw):
+    kw.setdefault("sustain_s", 1.0)
+    kw.setdefault("cooldown_s", 2.0)
+    return ReactiveController(
+        dispatcher=lambda: d, burn=lambda: burn_box["v"],
+        refuse_streams=refuse, samples=samples, clock=clock, **kw)
+
+
+def test_controller_escalates_the_brownout_ladder_and_exits_symmetrically():
+    clock = FakeClock()
+    d = FakeDispatcher()
+    refusals = []
+    burn = {"v": 5.0}
+    c = _controller(d, burn, clock, refuse=refusals.append)
+    assert c.tick() is None  # burn high but not yet sustained
+    clock.advance(1.1)
+    assert c.tick() == "window_down"  # rung 1: window + inflight halved
+    assert c.level == 1 and d.window_ms == 1.0 and d.max_inflight == 1
+    clock.advance(0.5)
+    assert c.tick() is None  # cooldown holds the next rung back
+    clock.advance(2.0)  # cooldown passed AND burn re-sustained
+    assert c.tick() == "admission_tighten"  # rung 2: shed earlier
+    assert d.deadline_safety == 2.0
+    clock.advance(0.5)
+    assert c.tick() is None  # one rung per cooldown, never a cascade
+    clock.advance(3.0)
+    assert c.tick() == "refuse_streams"  # rung 3
+    assert c.level == 3 and refusals == [True]
+    # symmetric exit: sustained low burn walks back down rung by rung
+    burn["v"] = 0.1
+    clock.advance(3.5)
+    assert c.tick() is None  # the low signal starts sustaining here
+    clock.advance(1.1)
+    assert c.tick() == "accept_streams" and refusals == [True, False]
+    clock.advance(0.5)
+    assert c.tick() is None  # restarts the low timer, inside cooldown
+    clock.advance(2.0)
+    assert c.tick() == "admission_relax" and d.deadline_safety == 1.0
+    clock.advance(0.5)
+    assert c.tick() is None
+    clock.advance(2.0)
+    assert c.tick() == "window_up"
+    assert c.level == 0 and d.window_ms == 2.0 and d.max_inflight == 2
+
+
+def test_controller_hysteresis_dead_band_and_spikes_do_nothing():
+    clock = FakeClock()
+    d = FakeDispatcher()
+    burn = {"v": 0.7}  # inside the dead band
+    c = _controller(d, burn, clock)
+    for _ in range(10):
+        clock.advance(1.0)
+        assert c.tick() is None
+    # a spike shorter than sustain_s is ignored
+    burn["v"] = 9.0
+    assert c.tick() is None
+    burn["v"] = 0.7
+    clock.advance(0.5)
+    assert c.tick() is None
+    assert c.level == 0 and c.actions_total == 0
+
+
+def test_controller_aimd_inflight_increase_under_backlog():
+    clock = FakeClock()
+    d = FakeDispatcher()
+    d._backlog = 4
+    burn = {"v": 0.0}
+    c = _controller(d, burn, clock, inflight_cap=4)
+    assert c.tick() is None  # the low-burn timer starts here
+    clock.advance(1.1)
+    assert c.tick() == "inflight_up" and d.max_inflight == 3
+    clock.advance(0.5)
+    assert c.tick() is None  # cooldown
+    clock.advance(2.0)
+    assert c.tick() == "inflight_up" and d.max_inflight == 4
+    clock.advance(0.5)
+    c.tick()
+    clock.advance(2.0)
+    assert c.tick() != "inflight_up"  # capped at inflight_cap
+
+
+def test_controller_bucket_floor_follows_backlog():
+    clock = FakeClock()
+    d = FakeDispatcher()
+    d.max_inflight = 8  # at cap: the floor branch is reachable
+    d._backlog = 6
+    burn = {"v": 0.0}
+    c = _controller(d, burn, clock, inflight_cap=8)
+    assert c.tick() is None  # the low-burn timer starts here
+    clock.advance(1.1)
+    assert c.tick() == "floor_up" and d.bucket_floor == 2
+    d._backlog = 0
+    clock.advance(0.5)
+    c.tick()
+    clock.advance(2.0)
+    assert c.tick() == "floor_down" and d.bucket_floor == 1
+
+
+def test_controller_mode_switch_follows_occupancy():
+    clock = FakeClock()
+    d = FakeDispatcher(router=FakeRouter(chips=4))
+    d.max_inflight = 8
+    burn = {"v": 0.0}
+    c = _controller(d, burn, clock, inflight_cap=8)
+    d.recent_batch = 4.5  # the mesh fills: one sharded dispatch wins
+    assert c.tick() is None  # the low-burn timer starts here
+    clock.advance(1.1)
+    assert c.tick() == "mode_sharded" and d.router.mode == "sharded"
+    d.recent_batch = 1.0  # occupancy collapsed
+    clock.advance(0.5)
+    c.tick()
+    clock.advance(2.0)
+    assert c.tick() == "mode_round_robin"
+    assert d.router.mode == "round_robin"
+
+
+def test_controller_min_samples_gates_the_burn_signal():
+    clock = FakeClock()
+    d = FakeDispatcher()
+    burn = {"v": 50.0}
+    samples = {"n": 3}
+    c = _controller(d, burn, clock, samples=lambda: samples["n"])
+    for _ in range(5):
+        clock.advance(1.1)
+        assert c.tick() is None  # an unfilled window never browns out
+    samples["n"] = 100
+    clock.advance(1.1)
+    assert c.tick() is None  # burn must now sustain from scratch
+    clock.advance(1.1)
+    assert c.tick() == "window_down"
+
+
+def test_resolve_controller_enabled_env(monkeypatch):
+    monkeypatch.delenv("RDP_CONTROLLER", raising=False)
+    assert resolve_controller_enabled(True) is True
+    assert resolve_controller_enabled(False) is False
+    monkeypatch.setenv("RDP_CONTROLLER", "1")
+    assert resolve_controller_enabled(False) is True
+    monkeypatch.setenv("RDP_CONTROLLER", "off")
+    assert resolve_controller_enabled(True) is False
+
+
+def test_controller_validates_thresholds():
+    with pytest.raises(ValueError, match="burn_low"):
+        ReactiveController(dispatcher=lambda: None, burn=lambda: 0.0,
+                           burn_high=0.5, burn_low=1.0)
+
+
+# ---------------------------------------------------------------------------
+# chip quarantine (DeviceRouter units on a fake clock)
+# ---------------------------------------------------------------------------
+
+
+def _quarantine_router(chips=4, failures=3, reset_s=10.0, clock=None,
+                       on_health=None):
+    return DeviceRouter(
+        mesh_lib.make_serving_mesh(chips), "round_robin",
+        breaker_failures=failures, breaker_reset_s=reset_s,
+        on_health=on_health, clock=clock or time.monotonic,
+    )
+
+
+def test_router_quarantines_after_threshold_and_flips_health():
+    clock = FakeClock()
+    health = []
+    r = _quarantine_router(clock=clock,
+                           on_health=lambda c, ok: health.append((c, ok)))
+    boom = RuntimeError("boom")
+    r.record_result(1, ok=False, exc=boom)
+    r.record_result(1, ok=False, exc=boom)
+    assert r.quarantined == frozenset()
+    r.record_result(1, ok=False, exc=boom)
+    assert r.quarantined == frozenset({1})
+    assert r.healthy_chips() == (0, 2, 3)
+    assert health == [(1, False)]
+    assert r.quarantines_total == 1
+
+
+def test_router_never_quarantines_the_last_healthy_chip():
+    clock = FakeClock()
+    r = _quarantine_router(chips=2, clock=clock)
+    boom = RuntimeError("boom")
+    for _ in range(3):
+        r.record_result(0, ok=False, exc=boom)
+    assert r.quarantined == frozenset({0})
+    for _ in range(10):
+        r.record_result(1, ok=False, exc=boom)
+    assert r.quarantined == frozenset({0})  # chip 1 is the last one
+    assert r.healthy_chips() == (1,)
+
+
+def test_router_probe_after_reset_reinstates_or_requarantines():
+    clock = FakeClock()
+    health = []
+    r = _quarantine_router(clock=clock, reset_s=10.0,
+                           on_health=lambda c, ok: health.append((c, ok)))
+    boom = RuntimeError("boom")
+    for _ in range(3):
+        r.record_result(2, ok=False, exc=boom)
+    assert r.probe_candidate() is None  # reset timeout not elapsed
+    clock.advance(10.5)
+    assert r.probe_candidate() == 2  # half-open: exactly one probe
+    assert r.probe_candidate() is None  # the probe slot is taken
+    r.record_result(2, ok=False, exc=boom)  # probe failed: re-open
+    clock.advance(5.0)
+    assert r.probe_candidate() is None
+    clock.advance(5.6)
+    assert r.probe_candidate() == 2
+    r.record_result(2, ok=True)  # probe succeeded: reinstated
+    assert r.quarantined == frozenset()
+    assert health[-1] == (2, True)
+
+
+def test_quarantine_disabled_for_sharded_and_single_chip():
+    mesh = mesh_lib.make_serving_mesh(4)
+    assert not DeviceRouter(mesh, "sharded",
+                            breaker_failures=3).quarantine_enabled
+    one = mesh_lib.make_serving_mesh(1)
+    assert not DeviceRouter(one, "round_robin",
+                            breaker_failures=3).quarantine_enabled
+    assert not DeviceRouter(mesh, "round_robin").quarantine_enabled
+    r = DeviceRouter(mesh, "round_robin", breaker_failures=3)
+    r.record_result(0, ok=False)  # no-op, never raises
+    assert r.probe_candidate() is None
+
+
+def test_mode_switch_requires_switchable_construction():
+    mesh = mesh_lib.make_serving_mesh(4)
+    r = DeviceRouter(mesh, "round_robin")
+    with pytest.raises(ValueError, match="mode-switchable"):
+        r.set_mode("sharded")
+    r.set_mode("round_robin")  # same mode: no-op, no validation
+    switchable = DeviceRouter(
+        mesh, "round_robin",
+        sharded_analyzer=lambda *a: {"sum": np.zeros((4,))},
+    )
+    assert switchable.can_switch_modes
+    switchable.set_mode("sharded")
+    assert switchable.mode == "sharded"
+    switchable.set_mode("round_robin")
+
+
+# ---------------------------------------------------------------------------
+# per-chip fault sites (RDP_FAULTS wildcard grammar)
+# ---------------------------------------------------------------------------
+
+
+def test_per_chip_fault_site_wildcard_matching():
+    configure_faults("serving.chip.*.dispatch:exc:2")
+    from robotic_discovery_platform_tpu.resilience import inject
+
+    with pytest.raises(RuntimeError, match="injected fault"):
+        inject("serving.chip.0.dispatch")
+    with pytest.raises(RuntimeError, match="injected fault"):
+        inject("serving.chip.3.dispatch")
+    inject("serving.chip.1.dispatch")  # budget exhausted: no fire
+    assert fired("serving.chip.0.dispatch") == 1
+    assert fired("serving.chip.3.dispatch") == 1
+    # an exact entry beats the wildcard
+    configure_faults(
+        "serving.chip.*.dispatch:exc:-1,serving.chip.2.dispatch:slow:0"
+    )
+    inject("serving.chip.2.dispatch")  # exact (exhausted slow): no fire
+    with pytest.raises(RuntimeError):
+        inject("serving.chip.0.dispatch")
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill one chip of a 4-chip mesh mid-stream, zero lost frames
+# ---------------------------------------------------------------------------
+
+
+def _sum_analyze():
+    def analyze(frames, depths, intr, scales):
+        f = np.asarray(frames)
+        return {"sum": f.reshape(f.shape[0], -1).sum(axis=1)
+                .astype(np.int64)}
+
+    return analyze
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_chaos_chip_kill_quarantine_failover_and_reinstatement():
+    """RDP_FAULTS kills chip 1's dispatches: after 3 failures the chip is
+    quarantined, every affected frame fails over to a healthy chip (zero
+    lost frames), and once the fault clears a half-open probe reinstates
+    the chip."""
+    quarantines_before = obs.CHIP_QUARANTINES.labels(chip="1").value
+    # 3 failures trip the breaker; the 4th fire eats the first probe, so
+    # reinstatement exercises a failed probe AND a successful one
+    configure_faults("serving.chip.1.dispatch:exc:4")
+    router = DeviceRouter(
+        mesh_lib.make_serving_mesh(4), "round_robin",
+        breaker_failures=3, breaker_reset_s=0.2,
+    )
+    d = BatchDispatcher(_sum_analyze(), window_ms=1.0, max_batch=1,
+                        max_inflight=1, router=router,
+                        watchdog_interval_s=0.0)
+    try:
+        outcomes: dict[int, object] = {}
+        threads = [_submit_bg(d, outcomes, v, 30.0, value=v)
+                   for v in range(1, 13)]
+        for t in threads:
+            t.join(timeout=30)
+        # ZERO lost frames: every submit delivered a real result even
+        # though chip 1's dispatches kept failing mid-stream
+        assert set(outcomes) == set(range(1, 13))
+        for v, out in outcomes.items():
+            assert not isinstance(out, BaseException), (v, out)
+            assert int(np.asarray(out["sum"])) == 8 * 8 * 3 * v
+        assert router.quarantines_total >= 1
+        assert obs.CHIP_QUARANTINES.labels(chip="1").value \
+            > quarantines_before
+        # recovery: the fault budget is exhausted, so a probe dispatch
+        # eventually succeeds and reinstates the chip
+        deadline = time.monotonic() + 15
+        while router.quarantined and time.monotonic() < deadline:
+            try:
+                d.submit(_FRAME, _DEPTH, _K, 0.001, timeout_s=5.0)
+            except Exception:
+                pass
+            time.sleep(0.05)
+        assert router.quarantined == frozenset()
+        assert fired("serving.chip.1.dispatch") == 4
+        # the reinstated chip takes dispatches again
+        before = d.chip_dispatches[1]
+        for v in range(20):
+            d.submit(_FRAME, _DEPTH, _K, 0.001, timeout_s=10.0)
+        assert d.chip_dispatches[1] > before
+    finally:
+        d.stop()
+
+
+def test_serial_parity_with_controller_running_but_idle():
+    """Acceptance: serial depth-1 results stay bitwise identical with
+    the controller enabled-but-idle (dead-band burn: it never acts)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def checksum(frames, depths, intr, scales):
+        f = frames.astype(jnp.float32) / 255.0
+        s = jnp.sum(f, axis=(1, 2, 3)) * (1.0 + scales)
+        return {"score": jnp.sin(s) + jnp.sqrt(s + 0.5)}
+
+    frames = [np.random.default_rng(i).integers(
+        0, 255, (8, 8, 3), dtype=np.uint8) for i in range(6)]
+
+    def run(with_controller: bool):
+        d = BatchDispatcher(checksum, window_ms=1.0, max_batch=2,
+                            max_inflight=1, watchdog_interval_s=0.0)
+        c = None
+        if with_controller:
+            c = ReactiveController(
+                dispatcher=lambda: d, burn=lambda: 0.7,  # dead band
+                interval_s=0.01,
+            )
+            c.start()
+        try:
+            return [np.asarray(
+                d.submit(f, _DEPTH, _K, 0.001, timeout_s=30.0)["score"])
+                for f in frames]
+        finally:
+            if c is not None:
+                c.stop()
+                assert c.actions_total == 0  # enabled but idle
+            d.stop()
+
+    plain = run(False)
+    controlled = run(True)
+    for a, b in zip(plain, controlled):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)  # bitwise
+
+
+def test_admission_module_exports():
+    # the server still imports OverloadedError from batching (back-compat
+    # re-export); both names must be the same class
+    assert batching_lib.OverloadedError is admission_lib.OverloadedError
