@@ -362,7 +362,8 @@ def main() -> None:
 
 
 def serving_pipeline_main(smoke: bool = False, chips: int = 1,
-                          dispatch_mode: str = "round_robin") -> None:
+                          dispatch_mode: str = "round_robin",
+                          precision: str = "f32") -> None:
     """serving_pipeline_fps: N synthetic concurrent streams through the
     LIVE BatchDispatcher (serving/batching.py), pipelined
     (max_inflight=2) vs serial (pipeline_depth=1), reporting aggregate
@@ -382,6 +383,12 @@ def serving_pipeline_main(smoke: bool = False, chips: int = 1,
     including under RDP_FAULTS="serving.batch.complete:exc:1", where the
     injected completer fault must error-complete its frames and leave the
     dispatcher serving (errored_frames >= 1, value > 0).
+
+    ``precision`` selects the serving tier (ops/pallas/quant.py: f32 /
+    bf16 / int8-weight-quantized). Every tier additionally reports parity
+    against an f32 reference analyzer over the parity frame set (mask
+    IoU, |delta curvature|) and whether the ServerConfig gate thresholds
+    pass; the within-tier pipelined-vs-serial check stays bitwise.
     """
     from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
     from robotic_discovery_platform_tpu.ops import pipeline
@@ -407,10 +414,23 @@ def serving_pipeline_main(smoke: bool = False, chips: int = 1,
     mcfg = ModelConfig(base_features=base, compute_dtype="float32")
     model = build_unet(mcfg)
     variables = init_unet(model, jax.random.key(0), img_size=img_size)
-    batch_analyze = pipeline.make_batch_analyzer(model, img_size=img_size)
+    # precision tier: the served engine binds the transformed pair; the
+    # pristine f32 pair stays around as the parity reference
+    from robotic_discovery_platform_tpu.ops.pallas import quant
+    from robotic_discovery_platform_tpu.utils.config import ServerConfig
+
+    served_model, served_vars, qreport = quant.apply_precision(
+        model, variables, precision
+    )
+    if qreport is not None and qreport.get("layers"):
+        print(f"# {precision}: quantized {qreport['layers']} conv kernels "
+              f"(max rel err {qreport['max_rel_err']:.2%})",
+              file=sys.stderr)
+    batch_analyze = pipeline.make_batch_analyzer(served_model,
+                                                 img_size=img_size)
 
     def analyze(frames, depths, intr, scales):
-        return batch_analyze(variables, frames, depths, intr, scales)
+        return batch_analyze(served_vars, frames, depths, intr, scales)
 
     def make_router() -> DeviceRouter:
         """Mesh + per-placement analyzers, mirroring the server's
@@ -420,11 +440,11 @@ def serving_pipeline_main(smoke: bool = False, chips: int = 1,
         if dispatch_mode == "round_robin":
             analyzers = [
                 (lambda f, d_, i, s, _v=v: batch_analyze(_v, f, d_, i, s))
-                for v in (jax.device_put(variables, dev)
+                for v in (jax.device_put(served_vars, dev)
                           for dev in mesh_lib.device_ring(mesh))
             ]
         else:
-            v_repl = mesh_lib.shard_pytree(mesh, variables)
+            v_repl = mesh_lib.shard_pytree(mesh, served_vars)
             analyzers = [
                 lambda f, d_, i, s: batch_analyze(v_repl, f, d_, i, s)
             ]
@@ -542,6 +562,31 @@ def serving_pipeline_main(smoke: bool = False, chips: int = 1,
         leaves_identical(a, b)
         for a, b in zip(pipelined["parity"], serial["parity"])
     )
+    # precision parity vs an f32 reference analyzer over the same parity
+    # frames, gated by the ServerConfig warm-up thresholds (at f32 the
+    # reference is the served model itself, so the report is the trivial
+    # 1.0-IoU / 0-delta anchor)
+    ref_batch_analyze = pipeline.make_batch_analyzer(model,
+                                                     img_size=img_size)
+    scfg = ServerConfig()
+    ref_outs, got_outs = [], []
+    for f, got in zip(parity_set, pipelined["parity"]):
+        if got is None:
+            continue
+        ref = jax.tree.map(
+            lambda a: a[0],
+            ref_batch_analyze(
+                variables, f[None], depth[None], intr[None],
+                np.full((1,), 0.001, np.float32),
+            ),
+        )
+        ref_outs.append(ref)
+        got_outs.append(got)
+    precision_parity = quant.parity_report(ref_outs, got_outs)
+    gates_pass = quant.parity_gates_pass(
+        precision_parity, scfg.quant_parity_min_iou,
+        scfg.quant_parity_max_curv_err,
+    )
     chip_note = ""
     if chips > 1:
         base_fps = one_chip["fps"] or 1e-9
@@ -558,12 +603,23 @@ def serving_pipeline_main(smoke: bool = False, chips: int = 1,
         f"high_water={pipelined['high_water']}) "
         f"{chip_note}"
         f"serial={serial['fps']:.1f}fps "
-        f"(overlap={serial['overlap_s']:.3f}s) identical={identical}",
+        f"(overlap={serial['overlap_s']:.3f}s) identical={identical} "
+        f"precision={precision} "
+        f"(iou={precision_parity['mask_iou_mean']:.4f} "
+        f"curv_err={precision_parity['curvature_err_max']:.4g} "
+        f"gates={'pass' if gates_pass else 'FAIL'})",
         file=sys.stderr,
     )
     payload = {
         "metric": "serving_pipeline_fps",
         "backend": jax.default_backend(),
+        "precision": precision,
+        "parity": {
+            **precision_parity,
+            "gates_pass": gates_pass,
+            "min_iou_gate": scfg.quant_parity_min_iou,
+            "max_curv_err_gate": scfg.quant_parity_max_curv_err,
+        },
         "value": round(pipelined["fps"], 2),
         "unit": "frames/sec",
         "serial_fps": round(serial["fps"], 2),
@@ -629,6 +685,15 @@ if __name__ == "__main__":
              "onto the least-loaded chip, or each bucket sharded over the "
              "mesh 'data' axis",
     )
+    parser.add_argument(
+        "--precision", default="f32", choices=["f32", "bf16", "int8"],
+        help="serving precision tier for --serving-pipeline "
+             "(ops/pallas/quant.py): f32 = untransformed (bitwise "
+             "identical to today), bf16 = bfloat16 activations, int8 = "
+             "bf16 activations + per-channel int8 weight quantization; "
+             "non-f32 tiers report parity vs the f32 reference and "
+             "whether the ServerConfig gates pass",
+    )
     cli = parser.parse_args()
     _metric = ("serving_pipeline_fps" if cli.serving_pipeline
                else _HEADLINE_METRIC)
@@ -652,7 +717,8 @@ if __name__ == "__main__":
     try:
         if cli.serving_pipeline:
             serving_pipeline_main(smoke=cli.smoke, chips=cli.chips,
-                                  dispatch_mode=cli.dispatch_mode)
+                                  dispatch_mode=cli.dispatch_mode,
+                                  precision=cli.precision)
         else:
             main()
     except Exception as e:  # noqa: BLE001 -- structured artifact by design

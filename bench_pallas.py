@@ -71,6 +71,29 @@ def _roundtrip_ms() -> float:
     return float(np.median(ts) * 1e3)
 
 
+def _roofline_fields(roof: dict, pallas_ms: float | None,
+                     xla_ms: float | None) -> dict:
+    """The per-shape roofline block every PALLASBENCH row carries:
+    analytic flops + minimal HBM traffic (utils/flops.py), the
+    compute-vs-bandwidth classification, and -- when a measurement is
+    present -- percent of the roofline bound achieved (the chain's
+    feedback-transform overhead rides on the measured time, so the
+    percentages are conservative)."""
+    out = {
+        "flops": roof["flops"],
+        "hbm_bytes": roof["bytes"],
+        "roofline_ms": round(roof["bound_ms"], 4),
+        "bound_by": roof["bound_by"],
+    }
+    if pallas_ms:
+        out["pallas_pct_of_bound"] = round(
+            100 * roof["bound_ms"] / pallas_ms, 1)
+    if pallas_ms and xla_ms:
+        out["best_pct_of_bound"] = round(
+            100 * roof["bound_ms"] / min(pallas_ms, xla_ms), 1)
+    return out
+
+
 def _time_chain(fn, x0, rt_ms: float, reps: int = 3) -> float:
     """Per-application ms of ``fn`` chained CHAIN times (x must map to an
     output that can be fed back; callers wrap to keep shapes fixed)."""
@@ -120,16 +143,11 @@ def bench_conv3x3(rt_ms: float) -> list[dict]:
         # chain's feedback tile/slice overhead rides on the measured time,
         # so pct_of_bound is understated -- a conservative bound)
         roof = flops_lib.conv3x3_roofline_ms(h, w, ci, co)
-        best_ms = min(t_pallas, t_xla)
         rows.append({
             "op": "conv3x3_bn_relu", "h": h, "w": w, "cin": ci, "cout": co,
             "pallas_ms": round(t_pallas, 4), "xla_ms": round(t_xla, 4),
             "speedup": round(t_xla / t_pallas, 3),
-            "roofline_ms": round(roof["bound_ms"], 4),
-            "bound_by": roof["bound_by"],
-            "pallas_pct_of_bound": round(
-                100 * roof["bound_ms"] / t_pallas, 1),
-            "best_pct_of_bound": round(100 * roof["bound_ms"] / best_ms, 1),
+            **_roofline_fields(roof, t_pallas, t_xla),
         })
         print(f"# 3x3 {h}x{w} {ci}->{co}: pallas={t_pallas:.3f}ms "
               f"xla={t_xla:.3f}ms x{t_xla / t_pallas:.2f} "
@@ -141,6 +159,7 @@ def bench_conv3x3(rt_ms: float) -> list[dict]:
 def bench_heads(rt_ms: float) -> list[dict]:
     from robotic_discovery_platform_tpu.ops.pallas import (
         conv1x1, conv1x1_xla, conv_transpose2x2, conv_transpose2x2_xla)
+    from robotic_discovery_platform_tpu.utils import flops as flops_lib
 
     rng = np.random.default_rng(1)
     rows = []
@@ -163,7 +182,10 @@ def bench_heads(rt_ms: float) -> list[dict]:
     t_p, t_x = _time_chain(head, x, rt_ms), _time_chain(head_xla, x, rt_ms)
     rows.append({"op": "conv1x1", "h": 256, "w": 256, "cin": 64, "cout": 1,
                  "pallas_ms": round(t_p, 4), "xla_ms": round(t_x, 4),
-                 "speedup": round(t_x / t_p, 3)})
+                 "speedup": round(t_x / t_p, 3),
+                 **_roofline_fields(
+                     flops_lib.conv1x1_roofline_ms(256, 256, 64, 1),
+                     t_p, t_x)})
     print(f"# 1x1 head: pallas={t_p:.3f}ms xla={t_x:.3f}ms", file=sys.stderr)
 
     # transpose-conv decoder step (non-bilinear variant): 32x32 512 -> 256
@@ -184,8 +206,136 @@ def bench_heads(rt_ms: float) -> list[dict]:
     t_p, t_x = _time_chain(tc, x, rt_ms), _time_chain(tc_xla, x, rt_ms)
     rows.append({"op": "conv_transpose2x2", "h": 32, "w": 32, "cin": 512,
                  "cout": 256, "pallas_ms": round(t_p, 4),
-                 "xla_ms": round(t_x, 4), "speedup": round(t_x / t_p, 3)})
+                 "xla_ms": round(t_x, 4), "speedup": round(t_x / t_p, 3),
+                 **_roofline_fields(
+                     flops_lib.conv_transpose2x2_roofline_ms(
+                         32, 32, 512, 256),
+                     t_p, t_x)})
     print(f"# 2x2^T: pallas={t_p:.3f}ms xla={t_x:.3f}ms", file=sys.stderr)
+    return rows
+
+
+def bench_geometry(rt_ms: float) -> list[dict]:
+    """Fused geometry/B-spline kernels (ops/pallas/geometry.py) vs their
+    XLA reference chains, at the deployed analyzer shapes: the 480x640
+    deproject+edge-stats pass (stride 1 and the pooled stride-2 view) and
+    the B-spline design/curvature stages (N = num_bins * max_per_bin =
+    6400 edge budget, C = 16 control points, 100 curvature samples)."""
+    import jax.numpy as jnp
+
+    from robotic_discovery_platform_tpu.ops import bspline, geometry
+    from robotic_discovery_platform_tpu.ops.pallas import (
+        geometry as pgeom,
+    )
+    from robotic_discovery_platform_tpu.utils import flops as flops_lib
+    from robotic_discovery_platform_tpu.utils.config import GeometryConfig
+
+    rng = np.random.default_rng(3)
+    rows = []
+    cfg = GeometryConfig()
+    big = jnp.float32(1e30)
+
+    # deproject + edge stats: feed the z map back as depth (z = depth *
+    # scale, so the chain is data-dependent and shape-stable); the tiny
+    # tanh(stat) term keeps the reductions live on both sides.
+    for stride in (1, 2):
+        h, w = 480 // stride, 640 // stride
+        mask = jnp.asarray(rng.random((h, w)) > 0.4, jnp.uint8)
+        d0 = jnp.asarray(rng.random((h, w)) * 800 + 200, jnp.float32)
+        fx = fy = jnp.float32(600.0)
+        cx, cy = jnp.float32(w / 2), jnp.float32(h / 2)
+
+        def step_pallas(d, stride=stride, mask=mask, fx=fx, fy=fy,
+                        cx=cx, cy=cy):
+            _, _, z, _, st = pgeom.deproject_edge_stats(
+                mask, d, fx, fy, cx, cy, 0.001, stride=stride
+            )
+            return z * 1000.0 + jnp.tanh(st[0])
+
+        def step_xla(d, stride=stride, mask=mask, fx=fx, fy=fy,
+                     cx=cx, cy=cy):
+            x, y, z, v = geometry.deproject(
+                mask, d, fx, fy, cx, cy, 0.001, stride=stride
+            )
+            xs, ys, vf = x.reshape(-1), y.reshape(-1), v.reshape(-1)
+            x_min = jnp.min(jnp.where(vf, xs, big))
+            jnp.max(jnp.where(vf, xs, -big))
+            return z * 1000.0 + jnp.tanh(x_min)
+
+        t_p = _time_chain(step_pallas, d0, rt_ms)
+        t_x = _time_chain(step_xla, d0, rt_ms)
+        roof = flops_lib.deproject_roofline_ms(h, w)
+        rows.append({
+            "op": "deproject_edge_stats", "h": h, "w": w, "stride": stride,
+            "pallas_ms": round(t_p, 4), "xla_ms": round(t_x, 4),
+            "speedup": round(t_x / t_p, 3),
+            **_roofline_fields(roof, t_p, t_x),
+        })
+        print(f"# deproject {h}x{w} s{stride}: pallas={t_p:.3f}ms "
+              f"xla={t_x:.3f}ms x{t_x / t_p:.2f}", file=sys.stderr)
+
+    # B-spline design + curvature at the deployed fit shapes
+    n, c = cfg.num_bins * cfg.max_per_bin, cfg.num_ctrl
+    knots = bspline.clamped_uniform_knots(c, cfg.spline_degree)
+    pts0 = jnp.asarray(rng.normal(size=(n, 3)), jnp.float32)
+    wts = jnp.asarray(rng.random(n) > 0.3, jnp.float32)
+
+    def design_pallas(pts, wts=wts):
+        u = bspline.chord_length_params(pts, wts)
+        _, rhs = pgeom.bspline_design(
+            pts, wts, u, pgeom.static_knots(knots), cfg.spline_degree
+        )
+        reps = -(-n // rhs.shape[0])
+        return pts + 1e-3 * jnp.tanh(jnp.tile(rhs, (reps, 1))[:n])
+
+    def design_xla(pts, wts=wts):
+        u = bspline.chord_length_params(pts, wts)
+        b = bspline.bspline_basis(u, knots, cfg.spline_degree)
+        bw = b * wts[:, None]
+        rhs = bspline._mm(bw.T, pts)
+        bspline._mm(bw.T, b)
+        reps = -(-n // rhs.shape[0])
+        return pts + 1e-3 * jnp.tanh(jnp.tile(rhs, (reps, 1))[:n])
+
+    t_p = _time_chain(design_pallas, pts0, rt_ms)
+    t_x = _time_chain(design_xla, pts0, rt_ms)
+    roof = flops_lib.bspline_design_roofline_ms(n, c)
+    rows.append({
+        "op": "bspline_design", "n": n, "c": c,
+        "pallas_ms": round(t_p, 4), "xla_ms": round(t_x, 4),
+        "speedup": round(t_x / t_p, 3),
+        **_roofline_fields(roof, t_p, t_x),
+    })
+    print(f"# bspline_design n{n} c{c}: pallas={t_p:.3f}ms "
+          f"xla={t_x:.3f}ms x{t_x / t_p:.2f}", file=sys.stderr)
+
+    ns = cfg.num_samples
+    u_fine = jnp.linspace(0.0, 1.0, ns)
+    ctrl0 = jnp.asarray(rng.normal(size=(c, 3)), jnp.float32)
+
+    def curv_pallas(ctrl):
+        kappa, _, r = pgeom.bspline_curvature(
+            ctrl, u_fine, pgeom.static_knots(knots), cfg.spline_degree
+        )
+        return ctrl + 1e-3 * jnp.tanh(r[:c] + kappa[:c, None])
+
+    def curv_xla(ctrl):
+        kappa, _, r = bspline.curvature_profile(
+            ctrl, knots, u_fine, cfg.spline_degree
+        )
+        return ctrl + 1e-3 * jnp.tanh(r[:c] + kappa[:c, None])
+
+    t_p = _time_chain(curv_pallas, ctrl0, rt_ms)
+    t_x = _time_chain(curv_xla, ctrl0, rt_ms)
+    roof = flops_lib.bspline_curvature_roofline_ms(ns, c)
+    rows.append({
+        "op": "bspline_curvature", "n": ns, "c": c,
+        "pallas_ms": round(t_p, 4), "xla_ms": round(t_x, 4),
+        "speedup": round(t_x / t_p, 3),
+        **_roofline_fields(roof, t_p, t_x),
+    })
+    print(f"# bspline_curvature n{ns} c{c}: pallas={t_p:.3f}ms "
+          f"xla={t_x:.3f}ms x{t_x / t_p:.2f}", file=sys.stderr)
     return rows
 
 
@@ -340,6 +490,7 @@ def main() -> None:
         "dtype": "bfloat16 in / f32 accumulate",
         "conv3x3": bench_conv3x3(rt_ms),
         "heads": bench_heads(rt_ms),
+        "geometry": bench_geometry(rt_ms),
         "full_forward_b1_256": bench_full_forward(rt_ms),
         "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }

@@ -25,6 +25,20 @@ JL006     warning   bare device pinning: subscripting
                     ``jax.devices()``/``jax.local_devices()``
 JL007     error     ``jax.jit`` called inside a loop body -- a fresh jit
                     cache (and likely a fresh compile) per iteration
+JL008     error     Pallas grid/BlockSpec mismatch: an index_map lambda
+                    whose arity differs from the ``pallas_call`` grid
+                    rank, or whose returned index tuple's length differs
+                    from the block shape's rank
+JL009     error     out-of-tile ``pl.load``/``pl.store``/subscript: a
+                    LITERAL index into a kernel ref at or beyond that
+                    ref's literal block-shape dim (checked only when both
+                    sides are compile-time constants -- no false fires on
+                    computed tilings)
+JL010     error     Pallas VMEM budget: the double-buffered, lane-padded
+                    sum of a ``pallas_call``'s literal block shapes
+                    exceeds the scoped-VMEM budget the conv kernels
+                    enforce analytically (ops/pallas/conv.vmem_bytes_3x3
+                    and its _VMEM_BUDGET)
 ========  ========  =====================================================
 
 "Jitted code" is computed statically: functions decorated with
@@ -53,6 +67,9 @@ RULES = {
     "JL005": "jax.numpy computation at module import time",
     "JL006": "bare device pinning via jax.devices()[i]",
     "JL007": "jax.jit called inside a loop",
+    "JL008": "Pallas grid/BlockSpec shape mismatch",
+    "JL009": "out-of-tile Pallas load/store index",
+    "JL010": "Pallas blocks exceed the VMEM budget",
 }
 
 _JIT_WRAPPERS = {
@@ -472,6 +489,238 @@ def _module_level_findings(
                     ))
 
 
+# -- Pallas kernel-body rules (JL008-JL010) ---------------------------------
+#
+# These fire only on modules that import jax.experimental.pallas, and only
+# on compile-time-literal evidence: a computed grid, tile expression, or
+# index never fires (the shipped kernels parameterize everything, which is
+# exactly why their lint stays clean while fixture kernels with literal
+# mistakes light up).
+
+_PALLAS_MODULE = "jax.experimental.pallas"
+# fallback when ops/pallas/conv is unimportable (standalone lint runs):
+# the same 10 MB figure conv._VMEM_BUDGET pins against the 16 MB limit
+_VMEM_BUDGET_FALLBACK = 10 * 1024 * 1024
+
+
+def _vmem_budget() -> int:
+    try:
+        from robotic_discovery_platform_tpu.ops.pallas.conv import (
+            _VMEM_BUDGET,
+        )
+
+        return _VMEM_BUDGET
+    except Exception:
+        return _VMEM_BUDGET_FALLBACK
+
+
+def _imports_pallas(aliases: _Aliases) -> bool:
+    return any(
+        v == _PALLAS_MODULE or v.startswith(_PALLAS_MODULE + ".")
+        for v in aliases.names.values()
+    )
+
+
+def _literal_int_tuple(node: ast.AST) -> list[int | None] | None:
+    """Elements of a literal tuple/list as ints (None for non-literal
+    elements); None when the node is not a tuple/list at all."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: list[int | None] = []
+    for e in node.elts:
+        out.append(e.value if isinstance(e, ast.Constant)
+                   and isinstance(e.value, int) else None)
+    return out
+
+
+def _spec_entries(node: ast.AST, aliases: _Aliases):
+    """The entries of an in_specs/out_specs expression IN ORDER (a
+    list/tuple of specs, or one bare spec): each yielded as the BlockSpec
+    Call node, or None for anything else (a variable, a helper-built
+    spec) -- order is preserved so positional ref binding stays aligned."""
+    candidates = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for c in candidates:
+        if isinstance(c, ast.Call) and (
+            aliases.canonical(c.func) or ""
+        ).endswith(".BlockSpec"):
+            yield c
+        else:
+            yield None
+
+
+def _spec_shape_and_index_map(spec: ast.Call):
+    """(shape node | None, index_map node | None) of one BlockSpec call."""
+    shape = spec.args[0] if spec.args else None
+    index_map = spec.args[1] if len(spec.args) > 1 else None
+    for kw in spec.keywords:
+        if kw.arg == "block_shape":
+            shape = kw.value
+        elif kw.arg == "index_map":
+            index_map = kw.value
+    return shape, index_map
+
+
+def _kernel_def_for(call: ast.Call, aliases: _Aliases,
+                    defs: dict[str, ast.FunctionDef]):
+    """The module-local FunctionDef a pallas_call invokes: a bare name or
+    ``functools.partial(name, ...)``; None when unresolvable."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Call):
+        fname = aliases.canonical(target.func)
+        if fname in ("functools.partial", "partial") and target.args:
+            target = target.args[0]
+    if isinstance(target, ast.Name):
+        return defs.get(target.id)
+    return None
+
+
+def _lane_padded_bytes(shape: list[int | None], itemsize: int = 4) -> int | None:
+    """Double-buffered VMEM estimate for one literal block: product of the
+    dims with the final dim padded to a 128-lane multiple (the same
+    accounting as ops/pallas/conv.vmem_bytes_3x3 / _lane); None when any
+    dim is non-literal."""
+    if shape is None or any(d is None for d in shape) or not shape:
+        return None
+    dims = list(shape)
+    dims[-1] = -(-dims[-1] // 128) * 128
+    total = itemsize
+    for d in dims:
+        total *= d
+    return 2 * total
+
+
+def _check_kernel_indices(
+    kernel: ast.FunctionDef, shapes: list[list[int | None] | None],
+    aliases: _Aliases, out: list[Finding], path: str,
+) -> None:
+    """JL009 inside one kernel body: literal subscripts / pl.load /
+    pl.store indices checked against the positionally-bound literal block
+    shapes."""
+    pos = kernel.args.posonlyargs + kernel.args.args
+    by_ref = {a.arg: s for a, s in zip(pos, shapes)}
+
+    def check_index(ref_name: str, idx_node: ast.AST, where: ast.AST):
+        shape = by_ref.get(ref_name)
+        if shape is None:
+            return
+        idxs = (idx_node.elts if isinstance(idx_node, ast.Tuple)
+                else [idx_node])
+        for dim, e in enumerate(idxs):
+            if dim >= len(shape) or shape[dim] is None:
+                continue
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                v = e.value
+                if v >= shape[dim] or v < -shape[dim]:
+                    out.append(Finding(
+                        path, where.lineno, where.col_offset, "JL009",
+                        ERROR,
+                        f"index {v} into ref {ref_name!r} dim {dim} is "
+                        f"outside its block shape {shape} -- Pallas "
+                        "loads/stores past the tile read/clobber "
+                        "neighboring VMEM",
+                    ))
+
+    for node in ast.walk(kernel):
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.value, ast.Name
+        ):
+            check_index(node.value.id, node.slice, node)
+        elif isinstance(node, ast.Call):
+            name = aliases.canonical(node.func) or ""
+            if name.endswith((".load", ".store")) and name.startswith(
+                _PALLAS_MODULE
+            ) and len(node.args) >= 2 and isinstance(
+                node.args[0], ast.Name
+            ):
+                check_index(node.args[0].id, node.args[1], node)
+
+
+def _pallas_findings(
+    tree: ast.Module, aliases: _Aliases, out: list[Finding], path: str
+) -> None:
+    if not _imports_pallas(aliases):
+        return
+    defs = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and (
+            aliases.canonical(node.func) or ""
+        ).endswith(".pallas_call")):
+            continue
+        kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+        grid = kwargs.get("grid")
+        if grid is None:
+            grid_rank: int | None = 0  # gridless: index_maps take no args
+        elif isinstance(grid, ast.Tuple):
+            grid_rank = len(grid.elts)
+        elif isinstance(grid, ast.Constant) and isinstance(grid.value, int):
+            grid_rank = 1
+        else:
+            grid_rank = None  # computed grid: no literal evidence
+
+        specs: list[ast.Call | None] = []
+        for key in ("in_specs", "out_specs"):
+            if key in kwargs:
+                specs.extend(_spec_entries(kwargs[key], aliases))
+
+        shapes: list[list[int | None] | None] = []
+        vmem_total, vmem_literal = 0, True
+        for spec in specs:
+            if spec is None:
+                shapes.append(None)
+                vmem_literal = False
+                continue
+            shape_node, index_map = _spec_shape_and_index_map(spec)
+            shape = (_literal_int_tuple(shape_node)
+                     if shape_node is not None else None)
+            shapes.append(shape)
+            # JL008: index_map arity vs grid rank; returned index rank vs
+            # block rank
+            if isinstance(index_map, ast.Lambda):
+                arity = len(index_map.args.args)
+                if grid_rank is not None and arity != grid_rank:
+                    out.append(Finding(
+                        path, spec.lineno, spec.col_offset, "JL008", ERROR,
+                        f"BlockSpec index_map takes {arity} grid "
+                        f"indices but the pallas_call grid has rank "
+                        f"{grid_rank} -- the kernel would be launched "
+                        "with mismatched block addressing",
+                    ))
+                ret = index_map.body
+                if isinstance(ret, ast.Tuple) and isinstance(
+                    shape_node, (ast.Tuple, ast.List)
+                ) and len(ret.elts) != len(shape_node.elts):
+                    out.append(Finding(
+                        path, spec.lineno, spec.col_offset, "JL008", ERROR,
+                        f"BlockSpec index_map returns "
+                        f"{len(ret.elts)} block indices for a rank-"
+                        f"{len(shape_node.elts)} block shape",
+                    ))
+            b = _lane_padded_bytes(shape)
+            if b is None:
+                vmem_literal = False
+            else:
+                vmem_total += b
+        # JL010: only when EVERY spec is literal (partial sums would
+        # understate and fire misleadingly)
+        if specs and vmem_literal and vmem_total > _vmem_budget():
+            out.append(Finding(
+                path, node.lineno, node.col_offset, "JL010", ERROR,
+                f"pallas_call blocks need ~{vmem_total} bytes of VMEM "
+                "(double-buffered, lane-padded) -- over the "
+                f"{_vmem_budget()}-byte budget the conv kernels enforce "
+                "(ops/pallas/conv.vmem_bytes_3x3); shrink the tiles",
+            ))
+        # JL009 inside the kernel body, when we can bind it
+        kernel = _kernel_def_for(node, aliases, defs)
+        if kernel is not None and any(s is not None for s in shapes):
+            _check_kernel_indices(kernel, shapes, aliases, out, path)
+
+
 def check_module(tree: ast.Module, path: str) -> list[Finding]:
     """All findings for one parsed module, unsuppressed and unsorted."""
     aliases = _Aliases(tree)
@@ -480,4 +729,5 @@ def check_module(tree: ast.Module, path: str) -> list[Finding]:
         _check_jit_body(root, aliases, out, path)
     _static_param_findings(tree, aliases, out, path)
     _module_level_findings(tree, aliases, out, path)
+    _pallas_findings(tree, aliases, out, path)
     return out

@@ -310,6 +310,15 @@ class UNet(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def with_compute_dtype(model: UNet, dtype: DType) -> UNet:
+    """A clone of ``model`` whose activations compute in ``dtype`` (params
+    stay float32 -- ``param_dtype`` is untouched). The serving precision
+    tiers (ops/pallas/quant.apply_precision) use this to force bf16
+    activations regardless of how the checkpoint was trained; the variable
+    tree is layout-identical so trained variables bind unchanged."""
+    return model.clone(dtype=jnp.dtype(dtype))
+
+
 def build_unet(cfg: ModelConfig = ModelConfig()) -> UNet:
     return UNet(
         num_classes=cfg.num_classes,

@@ -47,6 +47,30 @@ FRAME_LATENCY_SUMMARY = REGISTRY.summary(
     "write) -- the SLO tracker's signal.",
 )
 
+# -- precision tiers (ops/pallas/quant.py; ServerConfig.precision) -----------
+
+SERVING_PRECISION = REGISTRY.gauge(
+    "rdp_serving_precision",
+    "Info gauge: 1 on the label of the active serving precision tier "
+    "(f32, bf16, int8), 0 on the others.",
+    ("precision",),
+)
+QUANT_PARITY_IOU = REGISTRY.gauge(
+    "rdp_quant_parity_iou",
+    "Mean mask IoU of the reduced-precision serving engine against the "
+    "f32 goldens, measured at the warm-up parity check (1.0 at the f32 "
+    "tier by definition; serving refuses to start below "
+    "ServerConfig.quant_parity_min_iou).",
+)
+QUANT_PARITY_CURV = REGISTRY.gauge(
+    "rdp_quant_parity_curvature_err",
+    "Absolute curvature delta (1/m) of the reduced-precision engine vs "
+    "the f32 goldens at the warm-up parity check, by stat (mean, max); "
+    "the max drives the startup gate "
+    "(ServerConfig.quant_parity_max_curv_err).",
+    ("stat",),
+)
+
 # -- SLO (observability/slo.py; ServerConfig.slo_ms / RDP_SLO_MS) ------------
 
 SLO_OBJECTIVE = REGISTRY.gauge(

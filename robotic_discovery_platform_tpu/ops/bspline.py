@@ -44,19 +44,12 @@ def clamped_uniform_knots(num_ctrl: int, degree: int = 3) -> np.ndarray:
     ).astype(np.float64)
 
 
-def bspline_basis(u, knots, degree: int = 3):
-    """Cox-de Boor basis matrix, vectorized over parameters.
-
-    Args:
-        u: [N] parameters in [0, 1].
-        knots: [num_ctrl + degree + 1] knot vector (static).
-        degree: spline degree (static).
-
-    Returns:
-        [N, num_ctrl] basis matrix B with ``spline(u) = B @ ctrl``.
-    """
-    u = jnp.asarray(u)
-    knots = jnp.asarray(knots, dtype=u.dtype)
+def _basis_columns(uu, knots, degree: int):
+    """Cox-de Boor recursion on a COLUMN of parameters: ``uu`` is [N, 1],
+    ``knots`` an already-cast jnp vector. Shared verbatim by the XLA path
+    (:func:`bspline_basis`) and the fused Pallas kernels
+    (ops/pallas/geometry.py), so the two paths are the same ops and their
+    results compare bitwise."""
     n_knots = knots.shape[0]
     num_ctrl = n_knots - degree - 1
 
@@ -64,13 +57,12 @@ def bspline_basis(u, knots, degree: int = 3):
     # u == 1 lands in the last nonempty span (FITPACK convention).
     t_lo = knots[:-1][None, :]  # [1, n_knots-1]
     t_hi = knots[1:][None, :]
-    uu = u[:, None]
     last_span = t_hi >= knots[-1]
     b = jnp.where(
         (uu >= t_lo) & ((uu < t_hi) | (last_span & (uu <= t_hi))),
         1.0,
         0.0,
-    ).astype(u.dtype)
+    ).astype(uu.dtype)
     # Zero-width spans (clamped ends) must not fire.
     b = jnp.where((t_hi - t_lo) > 0, b, 0.0)
 
@@ -89,18 +81,29 @@ def bspline_basis(u, knots, degree: int = 3):
     return b
 
 
-def bspline_basis_derivative(u, knots, degree: int = 3, order: int = 1):
-    """Basis matrix of the ``order``-th derivative of the degree-``degree``
-    basis: ``spline^(k)(u) = D @ ctrl``.
+def bspline_basis(u, knots, degree: int = 3):
+    """Cox-de Boor basis matrix, vectorized over parameters.
 
-    Uses the standard recursion B'_{i,d} = d * (B_{i,d-1}/(t_{i+d}-t_i)
-    - B_{i+1,d-1}/(t_{i+d+1}-t_{i+1})) applied ``order`` times.
+    Args:
+        u: [N] parameters in [0, 1].
+        knots: [num_ctrl + degree + 1] knot vector (static).
+        degree: spline degree (static).
+
+    Returns:
+        [N, num_ctrl] basis matrix B with ``spline(u) = B @ ctrl``.
     """
-    if order == 0:
-        return bspline_basis(u, knots, degree)
-    knots_np = np.asarray(knots)
+    u = jnp.asarray(u)
+    knots = jnp.asarray(knots, dtype=u.dtype)
+    return _basis_columns(u[:, None], knots, degree)
+
+
+def _deriv_matrix_product(knots_np: np.ndarray, degree: int,
+                          order: int) -> np.ndarray:
+    """Static numpy product ``M_{p-order+1} @ ... @ M_p`` mapping the
+    degree-(p-order) basis to the order-th derivative of the degree-p
+    basis. Shared by :func:`bspline_basis_derivative` and the fused
+    curvature kernel (ops/pallas/geometry.py)."""
     n_knots = knots_np.shape[0]
-    num_ctrl = n_knots - degree - 1
 
     # D maps degree-(d-1) basis coefficients to the derivative contribution of
     # degree-d basis: a static sparse-ish [n_{d-1}, n_d] matrix per level.
@@ -117,12 +120,30 @@ def bspline_basis_derivative(u, knots, degree: int = 3, order: int = 1):
                 m[i + 1, i] -= d / dr
         return m
 
+    low = degree - order
+    return functools.reduce(
+        np.matmul, [deriv_matrix(d) for d in range(low + 1, degree + 1)]
+    )
+
+
+def bspline_basis_derivative(u, knots, degree: int = 3, order: int = 1):
+    """Basis matrix of the ``order``-th derivative of the degree-``degree``
+    basis: ``spline^(k)(u) = D @ ctrl``.
+
+    Uses the standard recursion B'_{i,d} = d * (B_{i,d-1}/(t_{i+d}-t_i)
+    - B_{i+1,d-1}/(t_{i+d+1}-t_{i+1})) applied ``order`` times.
+    """
+    if order == 0:
+        return bspline_basis(u, knots, degree)
+    knots_np = np.asarray(knots)
+    num_ctrl = knots_np.shape[0] - degree - 1
+
     # order-th derivative of degree-p basis = B_{p-order} @ M_{p-order+1} ... @ M_p
     low = degree - order
     if low < 0:
         return jnp.zeros((jnp.asarray(u).shape[0], num_ctrl))
     b = bspline_basis(u, knots, low)
-    m = functools.reduce(np.matmul, [deriv_matrix(d) for d in range(low + 1, degree + 1)])
+    m = _deriv_matrix_product(knots_np, degree, order)
     return _mm(b, jnp.asarray(m, dtype=b.dtype))
 
 
@@ -156,7 +177,8 @@ def second_difference_penalty(num_ctrl: int) -> np.ndarray:
 
 
 @shape_contract(points="n d", weights="n", knots="k")
-def fit_bspline(points, weights, knots, degree: int = 3, smoothing: float = 1e-3):
+def fit_bspline(points, weights, knots, degree: int = 3,
+                smoothing: float = 1e-3, impl: str = "xla"):
     """Weighted penalized least-squares B-spline fit (all shapes static).
 
     Solves ``(B^T W B + lam * P + eps I) C = B^T W X`` per coordinate, where
@@ -169,17 +191,36 @@ def fit_bspline(points, weights, knots, degree: int = 3, smoothing: float = 1e-3
         knots: static knot vector.
         degree: static degree.
         smoothing: penalty strength (plays the role of FITPACK ``s``).
+        impl: "xla" (default -- the reference path), or
+            "pallas"/"interpret" to run the basis + design contractions as
+            ONE fused Pallas kernel (ops/pallas/geometry.bspline_design;
+            the basis matrix stays in VMEM). Requires a static (numpy)
+            knot vector; the two paths are bitwise-compared in
+            tests/test_pallas_geometry.py. The [C, C] solve stays in XLA
+            either way (LU has no MXU win at C ~ 16).
 
     Returns:
         (ctrl [num_ctrl, D], u [N]) control points and per-point parameters.
     """
     u = chord_length_params(points, weights)
-    b = bspline_basis(u, knots, degree)  # [N, C]
     w = weights.astype(points.dtype)
-    bw = b * w[:, None]
-    num_ctrl = b.shape[1]
-    gram = _mm(bw.T, b)  # [C, C]
-    rhs = _mm(bw.T, points)  # [C, D]
+    num_ctrl = np.asarray(knots).shape[0] - degree - 1
+    if impl in ("pallas", "interpret") and not isinstance(
+        knots, jnp.ndarray
+    ):
+        from robotic_discovery_platform_tpu.ops.pallas import (
+            geometry as pallas_geometry,
+        )
+
+        gram, rhs = pallas_geometry.bspline_design(
+            points, w, u, pallas_geometry.static_knots(knots), degree,
+            interpret=impl == "interpret",
+        )
+    else:
+        b = bspline_basis(u, knots, degree)  # [N, C]
+        bw = b * w[:, None]
+        gram = _mm(bw.T, b)  # [C, C]
+        rhs = _mm(bw.T, points)  # [C, D]
     lam = smoothing * jnp.maximum(jnp.sum(w), 1.0)
     pen = jnp.asarray(second_difference_penalty(num_ctrl), dtype=points.dtype)
     reg = gram + lam * pen + 1e-8 * jnp.eye(num_ctrl, dtype=points.dtype)
@@ -195,20 +236,43 @@ def evaluate_bspline(ctrl, knots, u, degree: int = 3, order: int = 0):
     return _mm(d, ctrl)
 
 
+def _curvature_formula(r1, r2):
+    """kappa = ||r' x r''|| / ||r'||^3 with the reference's degenerate-
+    tangent guard (:155). Shared by the XLA path and the fused curvature
+    kernel so the two stay op-identical."""
+    cross = jnp.cross(r1, r2)
+    num = jnp.linalg.norm(cross, axis=-1)
+    den = jnp.linalg.norm(r1, axis=-1)
+    valid = den > 1e-6
+    kappa = jnp.where(valid, num / jnp.maximum(den, 1e-6) ** 3, 0.0)
+    return kappa, valid
+
+
 @shape_contract(ctrl="c d", knots="k", u="n")
-def curvature_profile(ctrl, knots, u, degree: int = 3):
+def curvature_profile(ctrl, knots, u, degree: int = 3, impl: str = "xla"):
     """kappa(u) = ||r' x r''|| / ||r'||^3 along the fitted curve
     (reference: pkg/geometry_utils.py:144-162), plus the sample points.
+
+    ``impl`` follows :func:`fit_bspline`: "pallas"/"interpret" fuses the
+    three derivative design matmuls and the curvature formula into one
+    Pallas launch (ops/pallas/geometry.bspline_curvature).
 
     Returns:
         (kappa [N], valid [N] bool, r [N, D]).
     """
+    if impl in ("pallas", "interpret") and not isinstance(
+        knots, jnp.ndarray
+    ):
+        from robotic_discovery_platform_tpu.ops.pallas import (
+            geometry as pallas_geometry,
+        )
+
+        return pallas_geometry.bspline_curvature(
+            ctrl, u, pallas_geometry.static_knots(knots), degree,
+            interpret=impl == "interpret",
+        )
     r = evaluate_bspline(ctrl, knots, u, degree, order=0)
     r1 = evaluate_bspline(ctrl, knots, u, degree, order=1)
     r2 = evaluate_bspline(ctrl, knots, u, degree, order=2)
-    cross = jnp.cross(r1, r2)
-    num = jnp.linalg.norm(cross, axis=-1)
-    den = jnp.linalg.norm(r1, axis=-1)
-    valid = den > 1e-6  # same degenerate-tangent guard as the reference (:155)
-    kappa = jnp.where(valid, num / jnp.maximum(den, 1e-6) ** 3, 0.0)
+    kappa, valid = _curvature_formula(r1, r2)
     return kappa, valid, r

@@ -75,7 +75,7 @@ def deproject(mask, depth, fx, fy, cx, cy, depth_scale, stride: int = 1):
     return x, y, z, valid
 
 
-def _edge_points(x, y, z, valid, cfg: GeometryConfig):
+def _edge_points(x, y, z, valid, cfg: GeometryConfig, stats=None):
     """Static-shape re-expression of ``_find_point_cloud_edge``
     (reference :119-142), operating directly on the dense deprojection
     maps: bin x into ``num_bins`` equal bins over the valid x-range, keep
@@ -89,6 +89,12 @@ def _edge_points(x, y, z, valid, cfg: GeometryConfig):
     budget at all). After the sort each bin is a contiguous descending-y
     segment, so "top k_b by y" is the head of each segment.
 
+    ``stats`` optionally carries pre-computed ``(x_min, x_max, y_min,
+    y_max, n_valid)`` -- the fused Pallas deproject kernel produces them
+    in its single pass over the maps; without it the reductions run here
+    (the XLA reference path). Min/max/integer-count are order-independent,
+    so both sources are bitwise-identical values.
+
     Returns ([num_bins * max_per_bin, 3] points, matching weights,
     edge_count, binnable flag, per-bin-cap flag).
     """
@@ -96,9 +102,13 @@ def _edge_points(x, y, z, valid, cfg: GeometryConfig):
     ys = y.reshape(-1)
     v = valid.reshape(-1)
     big = jnp.float32(1e30)
-    x_min = jnp.min(jnp.where(v, xs, big))
-    x_max = jnp.max(jnp.where(v, xs, -big))
-    n_valid = jnp.sum(v)
+    if stats is not None:
+        x_min, x_max, y_min_s, y_max_s, n_valid = stats
+    else:
+        x_min = jnp.min(jnp.where(v, xs, big))
+        x_max = jnp.max(jnp.where(v, xs, -big))
+        y_min_s = y_max_s = None
+        n_valid = jnp.sum(v)
     bin_width = (x_max - x_min) / cfg.num_bins
     binnable = (n_valid >= cfg.num_bins) & (bin_width > 0)
 
@@ -121,8 +131,10 @@ def _edge_points(x, y, z, valid, cfg: GeometryConfig):
             "(needs (num_bins + 1) << 25 < 2^31, i.e. num_bins <= 62)"
         )
     shift = jnp.int32(1 << 25)
-    y_min = jnp.min(jnp.where(v, ys, big))
-    y_max = jnp.max(jnp.where(v, ys, -big))
+    y_min = (y_min_s if y_min_s is not None
+             else jnp.min(jnp.where(v, ys, big)))
+    y_max = (y_max_s if y_max_s is not None
+             else jnp.max(jnp.where(v, ys, -big)))
     q_scale = ((1 << 25) - 1) / jnp.maximum(y_max - y_min, 1e-12)
     # Clip in FLOAT before the int cast: for a degenerate flat scene
     # (y_max ~ y_min) q_scale ~ 3.4e19 and the product overflows int32,
@@ -235,24 +247,55 @@ def compute_curvature_profile(
         mask = (masked_depth > 0).astype(jnp.uint8)
         depth = masked_depth
 
-    x, y, z, valid_map = deproject(
-        mask, depth, fx, fy, cx, cy, depth_scale, stride=s
+    # Fused-kernel dispatch (ops/pallas/geometry.py): "auto" resolves per
+    # backend with the PALLAS_TUNE.json table able to veto per (op, shape);
+    # the XLA branch below is the reference path the kernels are
+    # bitwise-compared against.
+    from robotic_discovery_platform_tpu.ops.pallas import (
+        geometry as pallas_geometry,
     )
-    cloud_count = jnp.sum(valid_map).astype(jnp.int32)
+
+    ph, pw = depth.shape
+    dep_impl = pallas_geometry.resolve_impl(
+        cfg.kernel_impl, "deproject", h=ph, w=pw, stride=s
+    )
+    if dep_impl in ("pallas", "interpret"):
+        x, y, z, valid_map, stats = pallas_geometry.deproject_edge_stats(
+            mask, depth, fx, fy, cx, cy, depth_scale, stride=s,
+            interpret=dep_impl == "interpret",
+        )
+    else:
+        x, y, z, valid_map = deproject(
+            mask, depth, fx, fy, cx, cy, depth_scale, stride=s
+        )
+        stats = None
+    cloud_count = (
+        stats[4] if stats is not None
+        else jnp.sum(valid_map).astype(jnp.int32)
+    )
 
     e_pts, e_w, edge_count, binnable, bin_capped = _edge_points(
-        x, y, z, valid_map, cfg
+        x, y, z, valid_map, cfg, stats
     )
     s_pts, s_w = _sort_by_x(e_pts, e_w)
 
+    n_edge = cfg.num_bins * cfg.max_per_bin
+    fit_impl = pallas_geometry.resolve_impl(
+        cfg.kernel_impl, "bspline_design", n=n_edge, c=cfg.num_ctrl
+    )
     knots = bspline.clamped_uniform_knots(cfg.num_ctrl, cfg.spline_degree)
     ctrl, _ = bspline.fit_bspline(
-        s_pts, s_w, knots, cfg.spline_degree, cfg.spline_smoothing
+        s_pts, s_w, knots, cfg.spline_degree, cfg.spline_smoothing,
+        impl=fit_impl,
     )
 
+    curv_impl = pallas_geometry.resolve_impl(
+        cfg.kernel_impl, "bspline_curvature", n=cfg.num_samples,
+        c=cfg.num_ctrl,
+    )
     u_fine = jnp.linspace(0.0, 1.0, cfg.num_samples)
     kappa, k_valid, r = bspline.curvature_profile(
-        ctrl, knots, u_fine, cfg.spline_degree
+        ctrl, knots, u_fine, cfg.spline_degree, impl=curv_impl
     )
     n_kv = jnp.sum(k_valid)
     mean_k = jnp.where(n_kv > 0, jnp.sum(kappa) / jnp.maximum(n_kv, 1), 0.0)

@@ -1,6 +1,8 @@
-"""Fused TPU Pallas kernels (conv-BN-ReLU, transpose-conv, 1x1 head) and the
-Pallas-backed U-Net inference forward. See conv.py for the kernel design and
-unet_infer.py for the per-layer pallas/XLA dispatch policy."""
+"""Fused TPU Pallas kernels (conv-BN-ReLU, transpose-conv, 1x1 head, the
+deproject+reduction and B-spline geometry kernels) and the Pallas-backed
+U-Net inference forward. See conv.py / geometry.py for the kernel designs,
+unet_infer.py for the per-layer pallas/XLA dispatch policy, and quant.py
+for the bf16/int8 serving precision tiers."""
 
 from robotic_discovery_platform_tpu.ops.pallas.conv import (  # noqa: F401
     conv1x1,

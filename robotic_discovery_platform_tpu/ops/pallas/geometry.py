@@ -1,0 +1,313 @@
+"""Fused Pallas kernels for the non-conv analyzer stages.
+
+The analyzer's remaining XLA-op chains (ROADMAP "Roofline-driven Pallas
+expansion") are bandwidth-bound elementwise/reduction pipelines that XLA
+emits as several HBM round trips:
+
+- **deproject + masked edge-stats** (ops/geometry.py): the pinhole
+  deprojection writes four dense [H, W] maps, then `_edge_points` re-reads
+  them five times for the masked min/max/count reductions that seed the
+  binning. :func:`deproject_edge_stats` computes the maps AND the five
+  reductions in ONE pass over the input tiles -- each pixel is read once,
+  the per-tile partials (one [1, 8] row per grid step) are folded outside
+  the kernel with order-independent min/max/integer-sum, so the result is
+  bitwise identical to the XLA reference path.
+- **B-spline design matmuls** (ops/bspline.py): the Cox-de Boor basis
+  matrix B [N, C] is materialized to HBM only to be immediately contracted
+  into the [C, C] Gram matrix and [C, D] right-hand side.
+  :func:`bspline_design` computes the basis in VMEM and performs both
+  contractions in the same kernel -- B never touches HBM.
+- **curvature evaluation** (ops/bspline.py): three derivative design
+  matrices and the cross/norm curvature formula fuse into
+  :func:`bspline_curvature`.
+
+Every kernel mirrors the XLA reference path op for op (the basis recursion
+and curvature formula are the SAME shared helpers from ops/bspline.py), so
+tests/test_pallas_geometry.py compares them BITWISE on CPU in interpret
+mode. Dispatch is per-shape via :func:`resolve_impl`:
+``GeometryConfig.kernel_impl`` ("auto" = Pallas on TPU, XLA elsewhere) with
+the PALLAS_TUNE.json autotable able to veto or force a backend per
+(op, shape) -- the same measured-overlay convention as the conv tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from robotic_discovery_platform_tpu.ops.pallas.conv import (
+    _pick_tile,
+    use_pallas,
+)
+
+KERNEL_IMPLS = ("auto", "pallas", "xla", "interpret")
+
+
+def resolve_impl(configured: str, op: str, **dims) -> str:
+    """The backend one fused-geometry launch runs: "pallas", "interpret",
+    or "xla".
+
+    ``configured`` is ``GeometryConfig.kernel_impl``: "xla" / "pallas" /
+    "interpret" pin a path; "auto" runs Pallas on TPU and XLA elsewhere,
+    with a per-(op, shape) entry in the PALLAS_TUNE.json table able to
+    override the default either way (the escape hatch for shapes where the
+    measured kernel loses to XLA, exactly like the conv tile overrides).
+    """
+    if configured not in KERNEL_IMPLS:
+        raise ValueError(
+            f"unknown kernel_impl {configured!r} (choose from "
+            f"{KERNEL_IMPLS})"
+        )
+    if configured != "auto":
+        return configured
+    from robotic_discovery_platform_tpu.ops.pallas import tuning
+
+    table = tuning.lookup_impl(op, **dims)
+    if table in ("pallas", "xla"):
+        return table
+    return "pallas" if use_pallas() else "xla"
+
+
+# -- deproject + masked edge-stats ------------------------------------------
+
+
+def _deproject_kernel(m_ref, d_ref, p_ref, x_ref, y_ref, z_ref, v_ref,
+                      s_ref, *, tile_h, width, stride):
+    """One row-tile grid step: maps + per-tile masked stats.
+
+    m_ref/d_ref: [tile_h, W] f32 mask/depth tiles (pre-cast by the
+        wrapper: uint8/uint16 -> f32 is exact).
+    p_ref: [1, 8] f32 parameter row (fx, fy, cx, cy, depth_scale, 0...).
+    x/y/z/v_ref: [tile_h, W] f32 output map tiles (v is 0/1).
+    s_ref: [1, 8] per-tile stats row: x_min, x_max, y_min, y_max, n_valid
+        (masked with the same +-1e30 sentinels as the XLA path, so folding
+        the rows with min/max/sum outside reproduces its values bitwise).
+    """
+    i = pl.program_id(0)
+    fx, fy = p_ref[0, 0], p_ref[0, 1]
+    cx, cy = p_ref[0, 2], p_ref[0, 3]
+    ds = p_ref[0, 4]
+    off = (stride - 1) / 2.0
+    vv = (jax.lax.broadcasted_iota(jnp.float32, (tile_h, width), 0)
+          + i * tile_h) * stride + off
+    uu = jax.lax.broadcasted_iota(jnp.float32, (tile_h, width), 1) \
+        * stride + off
+    z = d_ref[:] * ds
+    valid = (m_ref[:] > 0) & (z > 0)
+    x = (uu - cx) * z / fx
+    y = (vv - cy) * z / fy
+    x_ref[:] = x
+    y_ref[:] = y
+    z_ref[:] = z
+    v_ref[:] = valid.astype(jnp.float32)
+    big = jnp.float32(1e30)
+    s_ref[:] = jnp.zeros((1, 8), jnp.float32)
+    s_ref[0, 0] = jnp.min(jnp.where(valid, x, big))
+    s_ref[0, 1] = jnp.max(jnp.where(valid, x, -big))
+    s_ref[0, 2] = jnp.min(jnp.where(valid, y, big))
+    s_ref[0, 3] = jnp.max(jnp.where(valid, y, -big))
+    s_ref[0, 4] = jnp.sum(valid.astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("stride", "interpret"))
+def deproject_edge_stats(mask, depth, fx, fy, cx, cy, depth_scale, *,
+                         stride: int = 1, interpret: bool = False):
+    """Fused pinhole deprojection + masked edge-stat reductions.
+
+    Args:
+        mask, depth: [H, W] (any dtype; cast to f32 -- exact for the
+            uint8/uint16 camera formats).
+        fx, fy, cx, cy, depth_scale: scalars (traced OK).
+        stride: the pooled-view stride (iota coordinates scale, center
+            offset), same semantics as ops/geometry.deproject.
+
+    Returns ``(x, y, z, valid_bool, (x_min, x_max, y_min, y_max,
+    n_valid_i32))`` -- bitwise identical to the XLA reference path
+    (``deproject`` + the inline reductions of ``_edge_points``): the maps
+    are the same elementwise f32 ops, and min/max/integer-count folds are
+    order-independent.
+    """
+    h, width = depth.shape
+    mf = jnp.asarray(mask).astype(jnp.float32)
+    df = jnp.asarray(depth).astype(jnp.float32)
+    params = jnp.concatenate([
+        jnp.stack([
+            jnp.asarray(fx, jnp.float32), jnp.asarray(fy, jnp.float32),
+            jnp.asarray(cx, jnp.float32), jnp.asarray(cy, jnp.float32),
+            jnp.asarray(depth_scale, jnp.float32),
+        ]),
+        jnp.zeros((3,), jnp.float32),
+    ])[None, :]
+    tile_h = _pick_tile(h, 64)
+    tiles = h // tile_h
+    map_shape = jax.ShapeDtypeStruct((h, width), jnp.float32)
+    map_spec = pl.BlockSpec((tile_h, width), lambda i: (i, 0))
+    x, y, z, v, part = pl.pallas_call(
+        functools.partial(_deproject_kernel, tile_h=tile_h, width=width,
+                          stride=stride),
+        grid=(tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_h, width), lambda i: (i, 0)),
+            pl.BlockSpec((tile_h, width), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            map_spec, map_spec, map_spec, map_spec,
+            pl.BlockSpec((1, 8), lambda i: (i, 0)),
+        ],
+        out_shape=[map_shape, map_shape, map_shape, map_shape,
+                   jax.ShapeDtypeStruct((tiles, 8), jnp.float32)],
+        interpret=interpret,
+    )(mf, df, params)
+    stats = (
+        jnp.min(part[:, 0]),
+        jnp.max(part[:, 1]),
+        jnp.min(part[:, 2]),
+        jnp.max(part[:, 3]),
+        jnp.sum(part[:, 4]).astype(jnp.int32),
+    )
+    return x, y, z, v > 0, stats
+
+
+# -- fused B-spline design matrices -----------------------------------------
+
+
+def _design_kernel(u_ref, w_ref, p_ref, k_ref, g_ref, r_ref, *, degree):
+    """Single-step kernel: Cox-de Boor basis in VMEM, then the weighted
+    Gram/RHS contractions -- the basis matrix never reaches HBM. The basis
+    recursion and the matmul spelling are the SAME code the XLA path runs
+    (ops/bspline._basis_columns / _mm), so interpret-mode results match it
+    bitwise. The knot vector rides in as a [1, K] input (a kernel cannot
+    close over array constants)."""
+    from robotic_discovery_platform_tpu.ops import bspline
+
+    uu = u_ref[:]  # [N, 1]
+    b = bspline._basis_columns(uu, k_ref[0, :], degree)  # [N, C]
+    bw = b * w_ref[:]  # weights ride in as [N, 1]
+    g_ref[:] = bspline._mm(bw.T, b)
+    r_ref[:] = bspline._mm(bw.T, p_ref[:])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("knots", "degree", "interpret")
+)
+def bspline_design(points, weights, u, knots, degree: int = 3,
+                   interpret: bool = False):
+    """Fused ``(B^T W B, B^T W X)`` for the penalized least-squares fit.
+
+    Args:
+        points: [N, D]; weights: [N]; u: [N] parameters.
+        knots: STATIC knot vector as a tuple of floats (hashable; the
+            callers' knot vectors are compile-time numpy constants).
+
+    Returns ``(gram [C, C], rhs [C, D])`` in f32.
+    """
+    n = u.shape[0]
+    n_knots = len(knots)
+    num_ctrl = n_knots - degree - 1
+    d = points.shape[1]
+    pts = jnp.asarray(points, jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_design_kernel, degree=degree),
+        in_specs=[
+            pl.BlockSpec((n, 1), lambda: (0, 0)),
+            pl.BlockSpec((n, 1), lambda: (0, 0)),
+            pl.BlockSpec((n, d), lambda: (0, 0)),
+            pl.BlockSpec((1, n_knots), lambda: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((num_ctrl, num_ctrl), lambda: (0, 0)),
+            pl.BlockSpec((num_ctrl, d), lambda: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((num_ctrl, num_ctrl), jnp.float32),
+            jax.ShapeDtypeStruct((num_ctrl, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(u, jnp.float32)[:, None],
+        jnp.asarray(weights, jnp.float32)[:, None],
+        pts,
+        jnp.asarray(knots, jnp.float32)[None, :],
+    )
+
+
+# -- fused curvature evaluation ---------------------------------------------
+
+
+def _curvature_kernel(c_ref, u_ref, k_ref, m1_ref, m2_ref, kap_ref, v_ref,
+                      r_ref, *, degree):
+    """r, r', r'' via the shared basis recursion and the (input-fed)
+    static derivative-matrix products, then the curvature formula -- one
+    launch instead of three design matmuls plus an elementwise chain."""
+    from robotic_discovery_platform_tpu.ops import bspline
+
+    uu = u_ref[:]  # [N, 1]
+    ctrl = c_ref[:]
+    knots_j = k_ref[0, :]
+    r = bspline._mm(bspline._basis_columns(uu, knots_j, degree), ctrl)
+    b1 = bspline._basis_columns(uu, knots_j, degree - 1)
+    r1 = bspline._mm(bspline._mm(b1, m1_ref[:]), ctrl)
+    b2 = bspline._basis_columns(uu, knots_j, degree - 2)
+    r2 = bspline._mm(bspline._mm(b2, m2_ref[:]), ctrl)
+    kappa, valid = bspline._curvature_formula(r1, r2)
+    r_ref[:] = r
+    kap_ref[:] = kappa[:, None]
+    v_ref[:] = valid.astype(jnp.float32)[:, None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("knots", "degree", "interpret")
+)
+def bspline_curvature(ctrl, u, knots, degree: int = 3,
+                      interpret: bool = False):
+    """Fused curvature profile: ``(kappa [N], valid [N] bool, r [N, D])``,
+    bitwise-matching ops/bspline.curvature_profile's XLA path."""
+    from robotic_discovery_platform_tpu.ops import bspline
+
+    n = u.shape[0]
+    c, d = ctrl.shape
+    # knots is a STATIC tuple (static_argnames), not a traced value: the
+    # numpy conversion runs at trace time to build the static derivative
+    # matrices, exactly like the XLA path does.
+    knots_np = np.asarray(knots)  # jaxlint: disable=JL001
+    n_knots = knots_np.shape[0]
+    m1 = bspline._deriv_matrix_product(knots_np, degree, 1)  # [C+1, C]
+    m2 = bspline._deriv_matrix_product(knots_np, degree, 2)  # [C+2, C]
+    kappa, valid, r = pl.pallas_call(
+        functools.partial(_curvature_kernel, degree=degree),
+        in_specs=[
+            pl.BlockSpec((c, d), lambda: (0, 0)),
+            pl.BlockSpec((n, 1), lambda: (0, 0)),
+            pl.BlockSpec((1, n_knots), lambda: (0, 0)),
+            pl.BlockSpec(m1.shape, lambda: (0, 0)),
+            pl.BlockSpec(m2.shape, lambda: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((n, 1), lambda: (0, 0)),
+            pl.BlockSpec((n, 1), lambda: (0, 0)),
+            pl.BlockSpec((n, d), lambda: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(ctrl, jnp.float32),
+        jnp.asarray(u, jnp.float32)[:, None],
+        jnp.asarray(knots_np, jnp.float32)[None, :],
+        jnp.asarray(m1, jnp.float32),
+        jnp.asarray(m2, jnp.float32),
+    )
+    return kappa[:, 0], valid[:, 0] > 0, r
+
+
+def static_knots(knots) -> tuple:
+    """A hashable (static-arg) form of a numpy knot vector."""
+    return tuple(float(k) for k in np.asarray(knots))

@@ -1,0 +1,224 @@
+"""Precision tiers for the serving path: bf16 activations and int8 weights.
+
+The TPU paper's design rationale (Jouppi et al., ISCA 2017) is an MXU built
+for reduced precision -- bf16 multiplies with f32 accumulation at full rate,
+int8 at double rate. This module is the serving-side realization of that
+rationale for the U-Net analyzer:
+
+- ``"f32"`` -- no transformation at all. The engine is built exactly as the
+  model was trained/configured, so serving stays BITWISE identical to the
+  pre-precision-tier behavior (the parity anchor the other tiers are gated
+  against).
+- ``"bf16"`` -- activations in bfloat16 with f32 accumulation: the model's
+  compute dtype is forced to bfloat16 (the existing Pallas conv kernels and
+  the Flax forward both accumulate their matmuls in f32 and store bf16).
+  Parameters stay f32.
+- ``"int8"`` -- bf16 activations plus **per-output-channel symmetric int8
+  weight quantization** of every conv kernel (3x3 DoubleConv convs, the 2x2
+  transposed conv, and the 1x1 head): ``w ~ round(w / s_c) * s_c`` with
+  ``s_c = max|w[..., c]| / 127``. The bound variables carry the DEQUANTIZED
+  values (exact int8-grid points, so the arithmetic is the int8 weight
+  error), which keeps every downstream consumer -- Flax apply, the
+  Pallas-fused PallasUNet, mesh replication -- unchanged.
+
+Quantization is applied **per engine generation** (serving/server.py calls
+:func:`apply_precision` inside ``_make_engine``), so a hot-reload of new
+registry weights re-quantizes automatically.
+
+Accuracy is not assumed: every non-f32 tier is gated by a parity check
+against f32 goldens (mask IoU + |delta curvature|) at server warm-up and in
+CI (:func:`parity_report`, ``bench.py --serving-pipeline --precision``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PRECISIONS = ("f32", "bf16", "int8")
+
+#: int8 symmetric range: [-127, 127] (the -128 code is unused so the grid
+#: is symmetric and dequantization needs one scale, no zero point).
+_QMAX = 127
+
+
+def resolve_precision(cfg_value: str, env: str | None = None) -> str:
+    """The serving precision tier: ``RDP_PRECISION`` overrides the config
+    value (same env-knob convention as RDP_SERVING_CHIPS et al.)."""
+    raw = env if env is not None else os.environ.get("RDP_PRECISION")
+    value = (raw if raw not in (None, "") else cfg_value).strip().lower()
+    if value not in PRECISIONS:
+        raise ValueError(
+            f"unknown precision {value!r} (choose from {PRECISIONS})"
+        )
+    return value
+
+
+# -- int8 weight quantization ------------------------------------------------
+
+
+def quantize_int8(w, axis: int = -1):
+    """Per-channel symmetric int8 quantization along ``axis``.
+
+    Returns ``(q int8, scale f32)`` with ``scale`` shaped like ``w`` reduced
+    over every axis but ``axis`` (kept, so ``q * scale`` broadcasts back).
+    All-zero channels get scale 1 (their codes are all 0 anyway).
+    """
+    w = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale):
+    """``q * scale`` back to f32 (exact int8-grid values)."""
+    return q.astype(jnp.float32) * scale
+
+
+def fake_quantize_int8(w, axis: int = -1):
+    """quantize -> dequantize in one step: the int8-grid projection of
+    ``w`` (what the bound serving variables carry)."""
+    q, scale = quantize_int8(w, axis)
+    return dequantize_int8(q, scale)
+
+
+def _is_conv_kernel(path: tuple, leaf) -> bool:
+    """Conv kernels in the UNet variable tree: named ``kernel`` with a
+    trailing output-channel axis -- 4-D HWIO (3x3, 2x2 transpose) and the
+    1x1 head. Norm scales/biases and conv biases stay f32: they are O(C)
+    parameters whose quantization saves nothing and costs accuracy."""
+    name = getattr(path[-1], "key", None) if path else None
+    return name == "kernel" and hasattr(leaf, "ndim") and leaf.ndim >= 2
+
+
+def quantize_unet_variables(variables) -> tuple[Any, dict]:
+    """Per-output-channel int8 weight quantization of every conv kernel in
+    a UNet variable tree. Returns ``(quantized_variables, report)``: the
+    variables carry dequantized (int8-grid) f32 values, structurally
+    identical to the input tree; the report records per-layer error and the
+    int8 storage footprint.
+    """
+    report = {"layers": 0, "int8_bytes": 0, "f32_bytes": 0,
+              "max_abs_err": 0.0, "max_rel_err": 0.0}
+
+    def leaf_fn(path, leaf):
+        if not _is_conv_kernel(path, leaf):
+            return leaf
+        q, scale = quantize_int8(leaf, axis=-1)
+        dq = dequantize_int8(q, scale)
+        err = float(jnp.max(jnp.abs(dq - jnp.asarray(leaf, jnp.float32))))
+        amax = float(jnp.max(jnp.abs(leaf)))
+        report["layers"] += 1
+        report["int8_bytes"] += int(np.prod(q.shape)) + 4 * int(
+            np.prod(scale.shape)
+        )
+        report["f32_bytes"] += 4 * int(np.prod(q.shape))
+        report["max_abs_err"] = max(report["max_abs_err"], err)
+        if amax > 0:
+            report["max_rel_err"] = max(
+                report["max_rel_err"], err / amax
+            )
+        return dq.astype(jnp.asarray(leaf).dtype)
+
+    quantized = jax.tree_util.tree_map_with_path(leaf_fn, variables)
+    return quantized, report
+
+
+# -- precision application ---------------------------------------------------
+
+
+def apply_precision(model, variables, precision: str):
+    """Transform ``(model, variables)`` for one serving precision tier.
+
+    Returns ``(model, variables, report)``; ``report`` is None for f32 (no
+    transformation -- the returned objects ARE the inputs, so the f32 tier
+    is bitwise identical to pre-tier serving by construction).
+    """
+    precision = resolve_precision(precision)
+    if precision == "f32":
+        return model, variables, None
+    from robotic_discovery_platform_tpu.models.unet import with_compute_dtype
+
+    model = with_compute_dtype(model, jnp.bfloat16)
+    if precision == "bf16":
+        return model, variables, {"tier": "bf16", "layers": 0}
+    quantized, report = quantize_unet_variables(variables)
+    report["tier"] = "int8"
+    return model, quantized, report
+
+
+# -- parity metrics ----------------------------------------------------------
+
+
+def mask_iou(a, b) -> float:
+    """Intersection-over-union of two binary masks; 1.0 when both empty
+    (two all-background masks agree perfectly)."""
+    a = np.asarray(a) > 0
+    b = np.asarray(b) > 0
+    union = np.logical_or(a, b).sum()
+    if union == 0:
+        return 1.0
+    return float(np.logical_and(a, b).sum() / union)
+
+
+def golden_frames(n: int, h: int, w: int, seed: int = 0):
+    """Deterministic synthetic actuator scenes (training/synthetic.py) for
+    parity calibration: structured frames with real geometry, not uniform
+    noise -- thresholded-sigmoid masks on noise flip arbitrarily at the
+    0.5 boundary and would make the gate meaningless."""
+    from robotic_discovery_platform_tpu.training.synthetic import render_scene
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        img, _, depth = render_scene(rng, h, w)
+        out.append((img, depth))
+    return out
+
+
+def parity_report(ref_outputs, got_outputs) -> dict:
+    """Compare two lists of FrameAnalysis-like outputs (same frames through
+    the f32 reference and a reduced-precision tier): mean mask IoU plus
+    mean/max absolute curvature delta over frames valid in the reference.
+    """
+    ious, curv_errs = [], []
+    valid_agree = 0
+    for ref, got in zip(ref_outputs, got_outputs):
+        ious.append(mask_iou(ref.mask, got.mask))
+        rv = bool(np.asarray(ref.profile.valid))
+        gv = bool(np.asarray(got.profile.valid))
+        valid_agree += int(rv == gv)
+        if rv and gv:
+            for field in ("mean_curvature", "max_curvature"):
+                curv_errs.append(abs(
+                    float(np.asarray(getattr(ref.profile, field)))
+                    - float(np.asarray(getattr(got.profile, field)))
+                ))
+        elif rv != gv:
+            # a validity flip is the worst curvature outcome: score it as
+            # the reference magnitude so the gate sees it
+            curv_errs.append(abs(
+                float(np.asarray(ref.profile.mean_curvature))
+            ) + abs(float(np.asarray(got.profile.mean_curvature))))
+    return {
+        "frames": len(ious),
+        "mask_iou_mean": float(np.mean(ious)) if ious else 1.0,
+        "mask_iou_min": float(np.min(ious)) if ious else 1.0,
+        "curvature_err_mean": float(np.mean(curv_errs)) if curv_errs else 0.0,
+        "curvature_err_max": float(np.max(curv_errs)) if curv_errs else 0.0,
+        "valid_agreement": valid_agree / max(len(ious), 1),
+    }
+
+
+def parity_gates_pass(report: dict, min_iou: float,
+                      max_curv_err: float) -> bool:
+    """The warm-up / CI gate: mean IoU at or above the floor AND the worst
+    curvature delta at or below the ceiling."""
+    return (report["mask_iou_mean"] >= min_iou
+            and report["curvature_err_max"] <= max_curv_err)

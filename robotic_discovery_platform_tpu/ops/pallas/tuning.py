@@ -104,6 +104,27 @@ def candidates(h: int, w: int, cin: int, cout: int,
     return out
 
 
+def op_key(op: str, **dims) -> str:
+    """Generic table key for the non-conv fused kernels: the op name plus
+    its sorted shape dims, e.g. ``deproject:h480:s1:w640`` or
+    ``bspline_design:c16:n6400``."""
+    parts = [f"{k}{v}" for k, v in sorted(dims.items())]
+    return ":".join([op] + parts)
+
+
+def lookup_impl(op: str, **dims) -> str | None:
+    """Measured backend override for one fused-geometry (op, shape):
+    ``"pallas"`` / ``"xla"``, or None to use the caller's default policy.
+    Written by the autotuner / by hand after a TPU bench window; entries
+    with any other value are ignored (a hand-edited table must never turn
+    into a dispatch crash)."""
+    entry = _table().get(op_key(op, **dims))
+    if not isinstance(entry, dict):
+        return None
+    impl = entry.get("impl")
+    return impl if impl in ("pallas", "xla") else None
+
+
 def save_entries(entries: dict, meta: dict) -> Path:
     """Write the tune table (autotuner only); invalidates the read cache."""
     _TUNE_PATH.write_text(json.dumps(
@@ -114,6 +135,6 @@ def save_entries(entries: dict, meta: dict) -> Path:
 
 
 __all__ = [
-    "key", "lookup", "candidates", "save_entries", "invalidate_cache",
-    "vmem_bytes_3x3", "_lane",
+    "key", "lookup", "candidates", "op_key", "lookup_impl",
+    "save_entries", "invalidate_cache", "vmem_bytes_3x3", "_lane",
 ]

@@ -13,6 +13,7 @@ work left is image decode and protobuf.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import NamedTuple
 
@@ -143,8 +144,19 @@ def _analyze_batch(model, variables, frames_rgb, depths, intrinsics,
         logits = forward(variables, x)
     masks = logits_to_native_masks(logits, h, w, threshold)
 
-    def per_frame(mask, depth, k, scale):
-        return geometry.compute_curvature_profile(mask, depth, k, scale, geom_cfg)
+    # The vmapped (dense-batch) leg pins the geometry kernels to the XLA
+    # path: batching a pallas_call multiplies its VMEM working set by B
+    # exactly like the dense U-Net forward (the measured VMEM-spill
+    # anti-scaling), and the fused kernels' win is single-frame HBM-pass
+    # elimination. The b == 1 fast path and the scan analyzer (B=1
+    # residency by design) keep cfg.kernel_impl as configured.
+    geom_cfg_vmap = (
+        geom_cfg if geom_cfg.kernel_impl == "xla"
+        else dataclasses.replace(geom_cfg, kernel_impl="xla")
+    )
+
+    def per_frame(mask, depth, k, scale, cfg=geom_cfg):
+        return geometry.compute_curvature_profile(mask, depth, k, scale, cfg)
 
     # Geometry batches under vmap: the packed-key lax.sort at its heart
     # lowers to ONE row-batched XLA sort over [B, H*W] (an earlier design's
@@ -156,7 +168,9 @@ def _analyze_batch(model, variables, frames_rgb, depths, intrinsics,
             per_frame(masks[0], depths[0], intrinsics[0], depth_scales[0]),
         )
     else:
-        profs = jax.vmap(per_frame)(masks, depths, intrinsics, depth_scales)
+        profs = jax.vmap(
+            lambda m, d, k, s: per_frame(m, d, k, s, geom_cfg_vmap)
+        )(masks, depths, intrinsics, depth_scales)
     coverage = 100.0 * jnp.mean(masks.astype(jnp.float32), axis=(1, 2))
     return FrameAnalysis(mask=masks, mask_coverage=coverage, profile=profs)
 

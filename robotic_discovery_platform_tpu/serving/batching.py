@@ -203,6 +203,15 @@ def resolve_dispatch_mode(configured: str) -> str:
     return mode
 
 
+def resolve_precision(configured: str) -> str:
+    """The effective serving precision tier: ``RDP_PRECISION`` when set,
+    else ``ServerConfig.precision`` (same env-knob convention as the
+    resolvers above; the validation lives with the quantizer)."""
+    from robotic_discovery_platform_tpu.ops.pallas import quant
+
+    return quant.resolve_precision(configured)
+
+
 class DeviceRouter:
     """Placement policy for the dispatcher's in-flight window over a
     serving mesh (``parallel.mesh.make_serving_mesh``).

@@ -103,9 +103,11 @@ from robotic_discovery_platform_tpu.serving import (
     controller as controller_lib,
     health as health_lib,
 )
+from robotic_discovery_platform_tpu.ops.pallas import quant
 from robotic_discovery_platform_tpu.serving.batching import (
     OverloadedError,
     resolve_dispatch_mode,
+    resolve_precision,
     resolve_serving_chips,
 )
 from robotic_discovery_platform_tpu.serving.metrics import MetricsWriter
@@ -212,6 +214,21 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # and variables, never devices. Only meaningful when micro-batching
         # is on (the single-frame path has no dispatch window to route).
         self.dispatch_mode = resolve_dispatch_mode(cfg.dispatch_mode)
+        # Serving precision tier (ops/pallas/quant.py): resolved ONCE at
+        # startup; every engine generation re-applies it in _make_engine,
+        # so a hot-reload of new registry weights re-quantizes. The
+        # pre-transform (f32) model/variables of the CURRENT generation
+        # are kept as the warm-up parity-gate reference.
+        self.precision = resolve_precision(cfg.precision)
+        self._pristine: tuple[Any, Any] = (model, variables)
+        #: warm-up parity report for bf16/int8 (None at f32 / pre-warm)
+        self.parity: dict | None = None
+        for p in quant.PRECISIONS:
+            obs.SERVING_PRECISION.labels(precision=p).set(
+                1.0 if p == self.precision else 0.0
+            )
+        if self.precision != "f32":
+            log.info("serving precision tier: %s", self.precision)
         self._serving_mesh = None
         chips = resolve_serving_chips(cfg.serving_mesh)
         if cfg.batch_window_ms > 0 and chips > 1:
@@ -361,6 +378,22 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
     def _make_engine(self, model, variables, version) -> Engine:
         cfg, geom_cfg = self.cfg, self.geom_cfg
+        # precision tier applied per GENERATION: the pristine (f32) pair is
+        # kept for the parity gate, the engine binds the transformed pair.
+        # At f32 apply_precision returns its inputs untouched, so that tier
+        # stays bitwise identical to pre-tier serving.
+        self._pristine = (model, variables)
+        model, variables, qreport = quant.apply_precision(
+            model, variables, self.precision
+        )
+        if qreport is not None and qreport.get("layers"):
+            log.info(
+                "int8-quantized %d conv kernels for version %s "
+                "(max |err| %.3g, %.1f%% rel; %d int8 bytes vs %d f32)",
+                qreport["layers"], version, qreport["max_abs_err"],
+                100 * qreport["max_rel_err"], qreport["int8_bytes"],
+                qreport["f32_bytes"],
+            )
         if self._serving_mesh is not None:
             # the Pallas-fused forward closes over default-device buffers
             # and has no partitioning rules, so under a serving mesh every
@@ -906,11 +939,73 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         with self._reload_lock:
             self._warm_engine(self._engine)
         self._analyze_frame(color, depth)
+        # bf16/int8 tiers must PROVE parity against the f32 goldens before
+        # readiness ever flips -- a quantized engine that fails its gate
+        # never serves a frame
+        self._parity_gate(width, height)
         # readiness flips ONLY here: a probe sees SERVING once the first
         # real frame path has compiled and run, never before
         self.mark_ready()
         log.info("warmed up %dx%d analyzer on %s", width, height,
                  jax.default_backend())
+
+    def _parity_gate(self, width: int, height: int) -> dict | None:
+        """Warm-up parity check for the reduced-precision tiers: run the
+        golden synthetic frames through BOTH an f32 reference analyzer
+        (built from the current generation's pristine variables) and the
+        live engine path (dispatcher when batching, single-frame analyze
+        otherwise), publish the rdp_quant_parity_* gauges, and refuse to
+        come up when the thresholds are breached. No-op at f32."""
+        if self.precision == "f32":
+            return None
+        cfg = self.cfg
+        ref_model, ref_variables = self._pristine
+        ref_analyze = pipeline.make_frame_analyzer(
+            ref_model, img_size=cfg.model_img_size, geom_cfg=self.geom_cfg
+        )
+        k = np.asarray(
+            self.intrinsics if self.intrinsics is not None
+            else _default_intrinsics(width, height), np.float32,
+        )
+        scale = np.float32(self.depth_scale)
+        eng = self._engine
+        refs, gots = [], []
+        for rgb, depth in quant.golden_frames(
+            cfg.quant_parity_frames, height, width
+        ):
+            refs.append(ref_analyze(ref_variables, rgb, depth, k, scale))
+            if eng.dispatcher is not None:
+                gots.append(eng.dispatcher.submit(rgb, depth, k,
+                                                  float(scale)))
+            else:
+                gots.append(eng.analyze(eng.variables, rgb, depth, k,
+                                        scale))
+        report = quant.parity_report(refs, gots)
+        self.parity = report
+        obs.QUANT_PARITY_IOU.set(report["mask_iou_mean"])
+        obs.QUANT_PARITY_CURV.labels(stat="mean").set(
+            report["curvature_err_mean"])
+        obs.QUANT_PARITY_CURV.labels(stat="max").set(
+            report["curvature_err_max"])
+        if not quant.parity_gates_pass(
+            report, cfg.quant_parity_min_iou, cfg.quant_parity_max_curv_err
+        ):
+            raise RuntimeError(
+                f"{self.precision} serving failed its parity gate vs the "
+                f"f32 goldens: mean IoU {report['mask_iou_mean']:.4f} "
+                f"(floor {cfg.quant_parity_min_iou}), max |d curvature| "
+                f"{report['curvature_err_max']:.4f} (ceiling "
+                f"{cfg.quant_parity_max_curv_err}) over "
+                f"{report['frames']} frames"
+            )
+        log.info(
+            "%s parity gate passed: mean IoU %.4f, curvature err "
+            "mean %.4g / max %.4g over %d goldens",
+            self.precision, report["mask_iou_mean"],
+            report["curvature_err_mean"], report["curvature_err_max"],
+            report["frames"],
+        )
+        return report
 
     def mark_ready(self) -> None:
         self.health.set_all(health_lib.SERVING)

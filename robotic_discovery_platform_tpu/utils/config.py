@@ -130,6 +130,15 @@ class GeometryConfig:
 
     num_bins: int = 50
     top_k_percent: float = 0.05
+    # Fused-kernel dispatch for the non-conv analyzer stages (the Pallas
+    # deproject+reduction and B-spline design/curvature kernels under
+    # ops/pallas/geometry.py): "auto" runs them on TPU (with the
+    # PALLAS_TUNE.json table able to veto per shape) and the XLA reference
+    # path elsewhere; "xla"/"pallas" pin one path; "interpret" runs the
+    # Pallas interpreter (the CPU test path). The XLA path is the numerics
+    # oracle -- the kernels are bitwise-compared against it in
+    # tests/test_pallas_geometry.py.
+    kernel_impl: str = "auto"
     # Uniform pixel decimation before edge extraction: stride 2 quarters the
     # dominant packed-key sort with curvature error quantified against the
     # scipy oracle in GEOMETRY_PARITY.json (validity cutoffs scale by
@@ -227,6 +236,24 @@ class ServerConfig:
     # frames the reference accepts (e.g. 150 native points spread over
     # <50 pooled cells).
     geometry_stride: int = 1
+    # Serving precision tier (ops/pallas/quant.py): "f32" = no
+    # transformation, bitwise identical to pre-tier serving; "bf16" =
+    # activations in bfloat16 with f32 accumulation (params stay f32);
+    # "int8" = bf16 activations + per-output-channel symmetric int8 weight
+    # quantization of every conv kernel, re-applied per engine generation
+    # (hot-reload re-quantizes). Non-f32 tiers are gated at warm-up by the
+    # parity thresholds below against f32 goldens. The RDP_PRECISION env
+    # var overrides this value.
+    precision: str = "f32"
+    # Warm-up parity gate for bf16/int8 (ignored at f32): synthetic golden
+    # frames are run through BOTH the precision-tier engine and an f32
+    # reference analyzer; serving refuses to come up when mean mask IoU
+    # falls below the floor or the worst |delta curvature| (1/m) exceeds
+    # the ceiling. Thresholds calibrated on the synthetic actuator corpus
+    # (tests/test_quant.py measures the real deltas well inside them).
+    quant_parity_frames: int = 4
+    quant_parity_min_iou: float = 0.90
+    quant_parity_max_curv_err: float = 0.5
     # Model forward implementation: "auto" = Pallas-fused kernels on TPU,
     # Flax/XLA elsewhere; "flax" / "pallas" force one path (ops/pallas).
     model_forward: str = "auto"

@@ -24,19 +24,17 @@ V5E_PEAK_BF16_TFLOPS = 197.0
 V5E_HBM_GBPS = 819.0
 
 
-def conv3x3_roofline_ms(h: int, w: int, cin: int, cout: int,
-                        batch: int = 1, itemsize: int = 2) -> dict:
-    """Roofline lower bound for one fused 3x3 conv+BN+ReLU launch:
-    compute time at the dense-bf16 MXU peak vs memory time for the
-    minimal HBM traffic (read input once, read weights once, write output
-    once -- halos/re-reads make real traffic strictly larger, so the
-    bound is optimistic and 'percent of bound' is conservative)."""
-    flops = 2 * 9 * batch * h * w * cin * cout
-    bytes_moved = itemsize * (
-        batch * h * w * cin + 9 * cin * cout + batch * h * w * cout
-    )
-    compute_ms = flops / (V5E_PEAK_BF16_TFLOPS * 1e12) * 1e3
-    memory_ms = bytes_moved / (V5E_HBM_GBPS * 1e9) * 1e3
+def roofline_ms(flops: int, bytes_moved: int,
+                peak_tflops: float = V5E_PEAK_BF16_TFLOPS,
+                hbm_gbps: float = V5E_HBM_GBPS) -> dict:
+    """Roofline lower bound for one kernel launch: compute time at the
+    chip's dense peak vs memory time for the given minimal HBM traffic.
+    A launch cannot run faster than ``max(compute_ms, memory_ms)``; real
+    traffic (halos, re-reads) is strictly larger than the minimum the
+    callers count, so the bound is optimistic and 'percent of bound' is a
+    conservative utilization figure."""
+    compute_ms = flops / (peak_tflops * 1e12) * 1e3
+    memory_ms = bytes_moved / (hbm_gbps * 1e9) * 1e3
     return {
         "flops": flops,
         "bytes": bytes_moved,
@@ -45,6 +43,82 @@ def conv3x3_roofline_ms(h: int, w: int, cin: int, cout: int,
         "bound_ms": max(compute_ms, memory_ms),
         "bound_by": "compute" if compute_ms >= memory_ms else "memory",
     }
+
+
+def conv3x3_roofline_ms(h: int, w: int, cin: int, cout: int,
+                        batch: int = 1, itemsize: int = 2) -> dict:
+    """Roofline for one fused 3x3 conv+BN+ReLU launch: minimal traffic is
+    read input once, read weights once, write output once."""
+    return roofline_ms(
+        2 * 9 * batch * h * w * cin * cout,
+        itemsize * (
+            batch * h * w * cin + 9 * cin * cout + batch * h * w * cout
+        ),
+    )
+
+
+def conv1x1_roofline_ms(h: int, w: int, cin: int, cout: int,
+                        batch: int = 1, itemsize: int = 2) -> dict:
+    """Roofline for the fused 1x1 head launch."""
+    return roofline_ms(
+        2 * batch * h * w * cin * cout,
+        itemsize * (
+            batch * h * w * cin + cin * cout + batch * h * w * cout
+        ),
+    )
+
+
+def conv_transpose2x2_roofline_ms(h: int, w: int, cin: int, cout: int,
+                                  batch: int = 1,
+                                  itemsize: int = 2) -> dict:
+    """Roofline for the 2x2 stride-2 transposed-conv launch (each INPUT
+    pixel spawns four taps; output is [2H, 2W])."""
+    return roofline_ms(
+        2 * 4 * batch * h * w * cin * cout,
+        itemsize * (
+            batch * h * w * cin + 4 * cin * cout
+            + batch * 4 * h * w * cout
+        ),
+    )
+
+
+def deproject_roofline_ms(h: int, w: int) -> dict:
+    """Roofline for the fused deproject+edge-stats kernel
+    (ops/pallas/geometry.py): ~12 VPU ops per pixel (two iota builds, the
+    z/x/y formulas, the validity test, five masked reductions) against
+    reading mask+depth once (f32) and writing the four maps once.
+    Bandwidth-bound by construction -- the kernel's whole purpose is
+    collapsing the XLA chain's multiple HBM passes into one."""
+    return roofline_ms(12 * h * w, 4 * (2 * h * w + 4 * h * w))
+
+
+def bspline_design_roofline_ms(n: int, c: int, d: int = 3,
+                               degree: int = 3) -> dict:
+    """Roofline for the fused B-spline design kernel: the Cox-de Boor
+    recursion (~8 VPU ops per (point, basis-function) per level) plus the
+    two MXU contractions, against reading u/w/points once and writing the
+    [C, C]+[C, D] outputs -- the [N, C] basis matrix itself never touches
+    HBM (that is the fusion's point, and why the XLA chain's traffic is
+    ~(2 + degree) x larger)."""
+    basis_flops = 8 * degree * n * (c + degree)
+    mm_flops = 2 * n * c * c + 2 * n * c * d
+    return roofline_ms(
+        basis_flops + mm_flops,
+        4 * (n * (2 + d) + c * c + c * d),
+    )
+
+
+def bspline_curvature_roofline_ms(n: int, c: int, d: int = 3,
+                                  degree: int = 3) -> dict:
+    """Roofline for the fused curvature kernel: three basis builds, three
+    design+evaluate matmul chains, and the cross/norm formula (~40 VPU
+    ops per sample), against ctrl+u in / kappa+valid+r out."""
+    basis_flops = 3 * 8 * degree * n * (c + degree)
+    mm_flops = 2 * n * c * d * 3 + 2 * n * (c + degree) * c * 2
+    return roofline_ms(
+        basis_flops + mm_flops + 40 * n,
+        4 * (c * d + n + n * (2 + d)),
+    )
 
 
 def unet_forward_flops(img_size: int = 256, base: int = 64,

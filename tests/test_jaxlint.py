@@ -206,6 +206,119 @@ CASES = [
             return [g(x) for x in xs]
         """,
     ),
+    (
+        "JL008",  # index_map arity != grid rank
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2.0
+
+        def run(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4, 2),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+                out_shape=jax.ShapeDtypeStruct((32, 256), jnp.float32),
+            )(x)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2.0
+
+        def run(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4, 2),
+                in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+                out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+                out_shape=jax.ShapeDtypeStruct((32, 256), jnp.float32),
+            )(x)
+        """,
+    ),
+    (
+        "JL009",  # literal load/store index outside the block shape
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[0, 0] = x_ref[9, 0]
+            pl.store(o_ref, (0, 130), x_ref[0, 0])
+
+        def run(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+            )(x)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[0, 0] = x_ref[7, 0]
+            pl.store(o_ref, (0, 127), x_ref[0, 0])
+
+        def run(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+            )(x)
+        """,
+    ),
+    (
+        "JL010",  # literal blocks exceed the scoped-VMEM budget
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:]
+
+        def run(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(2,),
+                in_specs=[pl.BlockSpec((2048, 1024), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((2048, 1024), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((4096, 1024), jnp.float32),
+            )(x)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:]
+
+        def run(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(32,),
+                in_specs=[pl.BlockSpec((128, 1024), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((128, 1024), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((4096, 1024), jnp.float32),
+            )(x)
+        """,
+    ),
 ]
 
 

@@ -1,0 +1,308 @@
+"""Fused geometry/B-spline Pallas kernels vs the XLA reference path.
+
+Bitwise comparisons run with BOTH paths co-traced in one jitted graph --
+the serving condition (geometry always runs inside the jitted analyzer),
+and the only framing under which "bitwise" is well-defined: separately
+compiled graphs may legally differ in FMA contraction. The kernels run in
+interpret mode on CPU (the compiled path is exercised on real TPU by
+bench_pallas.py bench_geometry)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.ops import bspline, geometry
+from robotic_discovery_platform_tpu.ops.pallas import (
+    geometry as pgeom,
+    tuning,
+)
+from robotic_discovery_platform_tpu.training.synthetic import render_scene
+from robotic_discovery_platform_tpu.utils.config import GeometryConfig
+
+RNG = np.random.default_rng(11)
+CFG_XLA = GeometryConfig(kernel_impl="xla")
+CFG_INT = GeometryConfig(kernel_impl="interpret")
+
+
+def _bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+# -- deproject + edge stats --------------------------------------------------
+
+
+def _ref_deproject_stats(mask, depth, fx, fy, cx, cy, ds, stride):
+    """The XLA reference: deproject + the exact inline reductions
+    _edge_points runs."""
+    x, y, z, v = geometry.deproject(mask, depth, fx, fy, cx, cy, ds,
+                                    stride=stride)
+    xs, ys, vf = x.reshape(-1), y.reshape(-1), v.reshape(-1)
+    big = jnp.float32(1e30)
+    stats = (
+        jnp.min(jnp.where(vf, xs, big)),
+        jnp.max(jnp.where(vf, xs, -big)),
+        jnp.min(jnp.where(vf, ys, big)),
+        jnp.max(jnp.where(vf, ys, -big)),
+        jnp.sum(vf).astype(jnp.int32),
+    )
+    return (x, y, z, v, stats)
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+@pytest.mark.parametrize(
+    "mask_kind", ["random", "empty", "full", "speckle"]
+)
+def test_deproject_edge_stats_bitwise(stride, mask_kind):
+    h, w = 96, 128
+    if mask_kind == "random":
+        mask = (RNG.random((h, w)) > 0.4).astype(np.uint8)
+    elif mask_kind == "empty":
+        mask = np.zeros((h, w), np.uint8)
+    elif mask_kind == "full":
+        mask = np.ones((h, w), np.uint8)
+    else:
+        mask = np.zeros((h, w), np.uint8)
+        mask[::17, ::13] = 1
+    depth = (RNG.random((h, w)) * 800 + 100).astype(np.uint16)
+    depth[::7, ::5] = 0  # z == 0 holes exercise the (z > 0) leg
+    # intrinsics ride in as TRACED scalars (an array through the jit
+    # boundary), matching the real pipeline (fx = intrinsics[0, 0]): a
+    # literal python float would be a compile-time constant the XLA path
+    # could strength-reduce (/const -> *recip) while the kernel reads it
+    # from its params block at runtime -- a 1-ulp artifact unit tests
+    # must not manufacture.
+    par = jnp.asarray([100.0, 110.0, 64.0, 48.0, 0.001], jnp.float32)
+
+    @jax.jit
+    def both(m, d, p):
+        args = (p[0], p[1], p[2], p[3], p[4])
+        return (
+            _ref_deproject_stats(m, d, *args, stride),
+            pgeom.deproject_edge_stats(m, d, *args, stride=stride,
+                                       interpret=True),
+        )
+
+    ref, got = both(jnp.asarray(mask), jnp.asarray(depth), par)
+    assert _bitwise(ref, got)
+
+
+def test_deproject_non_divisible_height():
+    # H with a small largest divisor forces a narrow row tile
+    h, w = 94, 128
+    mask = (RNG.random((h, w)) > 0.5).astype(np.uint8)
+    depth = (RNG.random((h, w)) * 500 + 100).astype(np.uint16)
+    par = jnp.asarray([90.0, 90.0, 64.0, 47.0, 0.001], jnp.float32)
+
+    @jax.jit
+    def both(m, d, p):
+        args = (p[0], p[1], p[2], p[3], p[4])
+        return (
+            _ref_deproject_stats(m, d, *args, 1),
+            pgeom.deproject_edge_stats(m, d, *args, stride=1,
+                                       interpret=True),
+        )
+
+    ref, got = both(jnp.asarray(mask), jnp.asarray(depth), par)
+    assert _bitwise(ref, got)
+
+
+# -- B-spline design ---------------------------------------------------------
+
+
+def test_bspline_design_bitwise():
+    n, c = 256, 16
+    knots = bspline.clamped_uniform_knots(c, 3)
+    pts = jnp.asarray(RNG.normal(size=(n, 3)), jnp.float32)
+    wts = jnp.asarray(RNG.random(n) > 0.3, jnp.float32)
+
+    @jax.jit
+    def both(pts, wts):
+        u = bspline.chord_length_params(pts, wts)
+        b = bspline.bspline_basis(u, knots, 3)
+        bw = b * wts[:, None]
+        ref = (bspline._mm(bw.T, b), bspline._mm(bw.T, pts))
+        got = pgeom.bspline_design(
+            pts, wts, u, pgeom.static_knots(knots), 3, interpret=True
+        )
+        return ref, got
+
+    ref, got = both(pts, wts)
+    assert _bitwise(ref, got)
+
+
+def test_fit_bspline_impl_paths_agree_bitwise():
+    n, c = 128, 16
+    knots = bspline.clamped_uniform_knots(c, 3)
+    pts = jnp.asarray(RNG.normal(size=(n, 3)), jnp.float32)
+    wts = jnp.asarray(RNG.random(n) > 0.2, jnp.float32)
+
+    @jax.jit
+    def both(pts, wts):
+        return (
+            bspline.fit_bspline(pts, wts, knots, impl="xla"),
+            bspline.fit_bspline(pts, wts, knots, impl="interpret"),
+        )
+
+    ref, got = both(pts, wts)
+    assert _bitwise(ref, got)
+
+
+# -- curvature ---------------------------------------------------------------
+
+
+def test_bspline_curvature_bitwise():
+    c = 16
+    knots = bspline.clamped_uniform_knots(c, 3)
+    ctrl = jnp.asarray(RNG.normal(size=(c, 3)), jnp.float32)
+    u = jnp.linspace(0.0, 1.0, 100)
+
+    @jax.jit
+    def both(ctrl):
+        return (
+            bspline.curvature_profile(ctrl, knots, u, 3, impl="xla"),
+            bspline.curvature_profile(ctrl, knots, u, 3,
+                                      impl="interpret"),
+        )
+
+    ref, got = both(ctrl)
+    assert _bitwise(ref, got)
+
+
+def test_curvature_degenerate_tangent_guard_matches():
+    """Near-degenerate control points (all equal: the tangent is pure f32
+    rounding noise straddling the 1e-6 guard) must produce the SAME valid
+    mask and kappa on both paths -- the guard may not flip differently."""
+    c = 16
+    knots = bspline.clamped_uniform_knots(c, 3)
+    ctrl = jnp.ones((c, 3), jnp.float32)
+    u = jnp.linspace(0.0, 1.0, 50)
+
+    @jax.jit
+    def both(ctrl):
+        return (
+            bspline.curvature_profile(ctrl, knots, u, 3, impl="xla"),
+            bspline.curvature_profile(ctrl, knots, u, 3,
+                                      impl="interpret"),
+        )
+
+    (k0, v0, _), (k1, v1, _) = both(ctrl)
+    assert np.array_equal(np.asarray(v0), np.asarray(v1))
+    assert np.array_equal(np.asarray(k0), np.asarray(k1))
+
+
+# -- end to end --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+def test_full_profile_bitwise_on_synthetic_scene(stride):
+    rng = np.random.default_rng(3)
+    _, mask, depth = render_scene(rng, 96, 128)
+    intr = jnp.asarray(
+        [[120.0, 0, 64], [0, 120.0, 48], [0, 0, 1]], jnp.float32
+    )
+    cx = dataclasses.replace(CFG_XLA, stride=stride)
+    cp = dataclasses.replace(CFG_INT, stride=stride)
+
+    @jax.jit
+    def both(m, d):
+        return (
+            geometry.compute_curvature_profile(m, d, intr, 0.001, cx),
+            geometry.compute_curvature_profile(m, d, intr, 0.001, cp),
+        )
+
+    ref, got = both(jnp.asarray(mask), jnp.asarray(depth))
+    assert bool(ref.valid), "synthetic scene must yield a valid profile"
+    assert _bitwise(ref, got)
+
+
+def test_full_profile_bitwise_on_invalid_frame():
+    cfg_x, cfg_p = CFG_XLA, CFG_INT
+    mask = np.zeros((64, 64), np.uint8)
+    depth = np.full((64, 64), 300, np.uint16)
+    intr = jnp.asarray([[60.0, 0, 32], [0, 60.0, 32], [0, 0, 1]],
+                       jnp.float32)
+
+    @jax.jit
+    def both(m, d):
+        return (
+            geometry.compute_curvature_profile(m, d, intr, 0.001, cfg_x),
+            geometry.compute_curvature_profile(m, d, intr, 0.001, cfg_p),
+        )
+
+    ref, got = both(jnp.asarray(mask), jnp.asarray(depth))
+    assert not bool(ref.valid)
+    assert _bitwise(ref, got)
+
+
+# -- dispatch ----------------------------------------------------------------
+
+
+def test_resolve_impl_pins_and_auto():
+    assert pgeom.resolve_impl("xla", "deproject", h=1, w=1) == "xla"
+    assert pgeom.resolve_impl("interpret", "deproject", h=1, w=1) == (
+        "interpret"
+    )
+    # auto on the CPU test backend falls back to XLA
+    assert pgeom.resolve_impl("auto", "deproject", h=480, w=640,
+                              stride=1) == "xla"
+    with pytest.raises(ValueError):
+        pgeom.resolve_impl("cuda", "deproject", h=1, w=1)
+
+
+def test_resolve_impl_honors_tuning_table(monkeypatch):
+    key = tuning.op_key("deproject", h=480, s=1, w=640)
+    monkeypatch.setattr(tuning, "_cache", {key: {"impl": "pallas"}})
+    assert pgeom.resolve_impl("auto", "deproject", h=480, s=1,
+                              w=640) == "pallas"
+    # malformed entries are ignored, not trusted
+    monkeypatch.setattr(tuning, "_cache", {key: {"impl": "gpu"}})
+    assert pgeom.resolve_impl("auto", "deproject", h=480, s=1,
+                              w=640) == "xla"
+    monkeypatch.setattr(tuning, "_cache", {key: "pallas"})
+    assert pgeom.resolve_impl("auto", "deproject", h=480, s=1,
+                              w=640) == "xla"
+
+
+def test_batch_analyzer_runs_fused_kernels():
+    """The batched analyzer with kernel_impl='interpret': the b == 1 fast
+    path and the vmapped b > 1 path (which pins geometry to XLA) must both
+    run and agree with the all-XLA analyzer."""
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.ops import pipeline
+    from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+    model = build_unet(ModelConfig(base_features=8,
+                                   compute_dtype="float32"))
+    variables = init_unet(model, jax.random.key(0), img_size=64)
+    rng = np.random.default_rng(5)
+    frames = np.stack([render_scene(rng, 64, 64)[0] for _ in range(2)])
+    depths = np.stack([render_scene(rng, 64, 64)[2] for _ in range(2)])
+    intr = np.broadcast_to(
+        np.asarray([[60.0, 0, 32], [0, 60.0, 32], [0, 0, 1]], np.float32),
+        (2, 3, 3),
+    )
+    scales = np.full((2,), 0.001, np.float32)
+    an_fused = pipeline.make_batch_analyzer(model, img_size=64,
+                                            geom_cfg=CFG_INT)
+    an_xla = pipeline.make_batch_analyzer(model, img_size=64,
+                                          geom_cfg=CFG_XLA)
+    for b in (1, 2):
+        got = an_fused(variables, frames[:b], depths[:b], intr[:b],
+                       scales[:b])
+        ref = an_xla(variables, frames[:b], depths[:b], intr[:b],
+                     scales[:b])
+        assert np.array_equal(np.asarray(got.mask), np.asarray(ref.mask))
+        np.testing.assert_allclose(
+            np.asarray(got.profile.mean_curvature),
+            np.asarray(ref.profile.mean_curvature), rtol=1e-5, atol=1e-6,
+        )

@@ -1,0 +1,272 @@
+"""Precision tiers (ops/pallas/quant.py): quantization units, tier parity
+on synthetic frames, the serving warm-up parity gate, and hot-reload
+re-quantization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.models.unet import (
+    build_unet,
+    init_unet,
+)
+from robotic_discovery_platform_tpu.ops import pipeline
+from robotic_discovery_platform_tpu.ops.pallas import quant
+from robotic_discovery_platform_tpu.serving import server as server_lib
+from robotic_discovery_platform_tpu.serving.batching import (
+    resolve_precision,
+)
+from robotic_discovery_platform_tpu.utils.config import (
+    ModelConfig,
+    ServerConfig,
+)
+
+RNG = np.random.default_rng(13)
+IMG = 64
+INTR = np.asarray(
+    [[0.94 * IMG, 0, IMG / 2], [0, 0.94 * IMG, IMG / 2], [0, 0, 1]],
+    np.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = build_unet(ModelConfig(base_features=8,
+                                   compute_dtype="float32"))
+    return model, init_unet(model, jax.random.key(0), img_size=IMG)
+
+
+@pytest.fixture(scope="module")
+def confident_vars(model_and_vars):
+    """Variables whose masks are NON-trivial on the golden frames: the
+    random-init head sits entirely below the sigmoid threshold (empty
+    masks would make IoU trivially 1.0), so the head bias is shifted to
+    the median logit -- the razor-edge worst case for quantization flips."""
+    import flax
+
+    model, variables = model_and_vars
+    frame, _ = quant.golden_frames(1, IMG, IMG)[0]
+    x = pipeline.preprocess(jnp.asarray(frame)[None], IMG)
+    logits = model.apply(variables, x, train=False)
+    flat = flax.traverse_util.flatten_dict(variables)
+    key = ("params", "Conv_0", "bias")
+    flat[key] = flat[key] - jnp.median(logits)
+    return flax.traverse_util.unflatten_dict(flat)
+
+
+# -- quantize / dequantize units ---------------------------------------------
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jnp.asarray(RNG.normal(size=(3, 3, 8, 16)), jnp.float32)
+    q, scale = quant.quantize_int8(w)
+    assert q.dtype == jnp.int8
+    assert scale.shape == (1, 1, 1, 16)
+    dq = quant.dequantize_int8(q, scale)
+    # per-channel error bounded by half a quantization step
+    err = jnp.abs(dq - w)
+    assert bool(jnp.all(err <= scale / 2 + 1e-7))
+
+
+def test_quantize_idempotent_on_grid_values():
+    w = jnp.asarray(RNG.normal(size=(4, 8)), jnp.float32)
+    dq = quant.fake_quantize_int8(w)
+    q1, s1 = quant.quantize_int8(dq)
+    dq2 = quant.dequantize_int8(q1, s1)
+    assert np.array_equal(np.asarray(dq), np.asarray(dq2))
+
+
+def test_quantize_zero_channel():
+    w = jnp.zeros((3, 3, 4, 2), jnp.float32)
+    q, scale = quant.quantize_int8(w)
+    assert bool(jnp.all(q == 0))
+    assert bool(jnp.all(scale == 1.0))  # guarded, not NaN/inf
+
+
+def test_quantize_unet_variables_structure(model_and_vars):
+    _, variables = model_and_vars
+    quantized, report = quant.quantize_unet_variables(variables)
+    assert report["layers"] > 0
+    assert 0 < report["max_rel_err"] < 0.01  # ~0.4% for 8-bit symmetric
+    assert report["int8_bytes"] < report["f32_bytes"] / 2
+    ref_paths = jax.tree_util.tree_flatten_with_path(variables)[0]
+    got_paths = jax.tree_util.tree_flatten_with_path(quantized)[0]
+    assert len(ref_paths) == len(got_paths)
+    changed = 0
+    for (pa, a), (pb, b) in zip(ref_paths, got_paths):
+        assert pa == pb
+        assert a.shape == b.shape and a.dtype == b.dtype
+        name = getattr(pa[-1], "key", None)
+        if name == "kernel":
+            changed += int(not np.array_equal(np.asarray(a),
+                                              np.asarray(b)))
+        else:
+            # biases / norm params / batch stats ride through untouched
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert changed == report["layers"]
+
+
+def test_apply_precision_tiers(model_and_vars):
+    model, variables = model_and_vars
+    m, v, rep = quant.apply_precision(model, variables, "f32")
+    assert m is model and v is variables and rep is None
+    m, v, rep = quant.apply_precision(model, variables, "bf16")
+    assert m.dtype == jnp.bfloat16 and v is variables
+    m, v, rep = quant.apply_precision(model, variables, "int8")
+    assert m.dtype == jnp.bfloat16
+    assert rep["tier"] == "int8" and rep["layers"] > 0
+    with pytest.raises(ValueError):
+        quant.apply_precision(model, variables, "fp4")
+
+
+def test_resolve_precision_env(monkeypatch):
+    assert resolve_precision("f32") == "f32"
+    monkeypatch.setenv("RDP_PRECISION", "int8")
+    assert resolve_precision("f32") == "int8"
+    monkeypatch.setenv("RDP_PRECISION", "tf32")
+    with pytest.raises(ValueError):
+        resolve_precision("f32")
+
+
+def test_mask_iou():
+    a = np.zeros((4, 4)); b = np.zeros((4, 4))
+    assert quant.mask_iou(a, b) == 1.0  # both empty agree
+    a[0, 0] = 1
+    assert quant.mask_iou(a, b) == 0.0
+    b[0, 0] = 1; b[1, 1] = 1
+    assert quant.mask_iou(a, b) == pytest.approx(0.5)
+
+
+# -- tier parity on synthetic frames -----------------------------------------
+
+
+def test_tier_parity_within_documented_tolerances(model_and_vars,
+                                                  confident_vars):
+    """bf16/int8 vs f32 on synthetic actuator scenes, with the head biased
+    to the MEDIAN logit -- every pixel sits near the decision threshold,
+    the worst case for precision-induced mask flips. Even there the mask
+    IoU stays >= 0.98 (documented tolerance; a trained, confident model
+    sits far inside the ServerConfig gate defaults)."""
+    model, _ = model_and_vars
+    frames = quant.golden_frames(4, IMG, IMG)
+    outs = {}
+    for tier in ("f32", "bf16", "int8"):
+        m, v, _ = quant.apply_precision(model, confident_vars, tier)
+        analyze = pipeline.make_frame_analyzer(m, img_size=IMG)
+        outs[tier] = [
+            analyze(v, f, d, INTR, np.float32(0.001)) for f, d in frames
+        ]
+    coverages = [float(o.mask_coverage) for o in outs["f32"]]
+    assert all(0 < c < 100 for c in coverages[:2]), coverages
+    for tier in ("bf16", "int8"):
+        report = quant.parity_report(outs["f32"], outs[tier])
+        assert report["frames"] == 4
+        assert report["mask_iou_mean"] >= 0.98, (tier, report)
+        assert np.isfinite(report["curvature_err_max"]), (tier, report)
+
+
+def test_f32_tier_bitwise_identity(model_and_vars):
+    """The f32 tier is the untransformed engine: same objects in, so the
+    analyzer output is bitwise identical to a pre-tier build."""
+    model, variables = model_and_vars
+    m, v, _ = quant.apply_precision(model, variables, "f32")
+    analyze_a = pipeline.make_frame_analyzer(model, img_size=IMG)
+    analyze_b = pipeline.make_frame_analyzer(m, img_size=IMG)
+    frame, depth = quant.golden_frames(1, IMG, IMG)[0]
+    a = analyze_a(variables, frame, depth, INTR, np.float32(0.001))
+    b = analyze_b(v, frame, depth, INTR, np.float32(0.001))
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+# -- serving integration -----------------------------------------------------
+
+
+def _make_service(model, variables, tmp_path, **cfg_kw):
+    cfg = ServerConfig(
+        model_img_size=IMG, reload_poll_s=0,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        tracking_uri=f"file:{tmp_path}/mlruns", **cfg_kw,
+    )
+    return server_lib.VisionAnalysisService(
+        model, variables, None, 0.001, cfg,
+    )
+
+
+def test_server_warmup_parity_gate_passes(model_and_vars, tmp_path):
+    from robotic_discovery_platform_tpu.observability import (
+        instruments as obs,
+    )
+
+    model, variables = model_and_vars
+    svc = _make_service(model, variables, tmp_path, precision="int8")
+    try:
+        svc.warmup(IMG, IMG)
+        assert svc.parity is not None
+        assert svc.parity["mask_iou_mean"] >= 0.9
+        assert obs.SERVING_PRECISION.labels(precision="int8").value == 1.0
+        assert obs.SERVING_PRECISION.labels(precision="f32").value == 0.0
+        assert obs.QUANT_PARITY_IOU.value == pytest.approx(
+            svc.parity["mask_iou_mean"]
+        )
+        assert obs.QUANT_PARITY_CURV.labels(stat="max").value == (
+            pytest.approx(svc.parity["curvature_err_max"])
+        )
+    finally:
+        svc.close()
+
+
+def test_server_warmup_parity_gate_fails_closed(model_and_vars, tmp_path):
+    """An unsatisfiable IoU floor must keep the server from coming up --
+    a quantized engine that cannot prove parity never serves."""
+    from robotic_discovery_platform_tpu.serving import health as health_lib
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+
+    model, variables = model_and_vars
+    svc = _make_service(model, variables, tmp_path, precision="int8",
+                        quant_parity_min_iou=1.01)
+    try:
+        with pytest.raises(RuntimeError, match="parity gate"):
+            svc.warmup(IMG, IMG)
+        assert svc.health.get(vision_grpc.SERVICE_NAME) == (
+            health_lib.NOT_SERVING
+        )
+    finally:
+        svc.close()
+
+
+def test_f32_tier_skips_gate(model_and_vars, tmp_path):
+    model, variables = model_and_vars
+    svc = _make_service(model, variables, tmp_path, precision="f32",
+                        quant_parity_min_iou=1.01)
+    try:
+        svc.warmup(IMG, IMG)  # impossible gate irrelevant at f32
+        assert svc.parity is None
+        assert svc._engine.variables is variables  # untransformed
+    finally:
+        svc.close()
+
+
+def test_hot_reload_requantizes_per_generation(model_and_vars, tmp_path):
+    """Quantization binds per engine generation: a new variable tree
+    through _make_engine (the hot-reload build path) carries the int8 grid
+    of the NEW weights, not the old ones."""
+    model, variables = model_and_vars
+    svc = _make_service(model, variables, tmp_path, precision="int8")
+    try:
+        gen1 = np.asarray(
+            svc._engine.variables["params"]["Conv_0"]["kernel"]
+        )
+        v2 = init_unet(model, jax.random.key(7), img_size=IMG)
+        engine2 = svc._make_engine(model, v2, 2)
+        gen2 = np.asarray(engine2.variables["params"]["Conv_0"]["kernel"])
+        expected, _ = quant.quantize_unet_variables(v2)
+        assert np.array_equal(
+            gen2, np.asarray(expected["params"]["Conv_0"]["kernel"])
+        )
+        assert not np.array_equal(gen1, gen2)
+        # the pristine reference followed the generation swap too
+        assert svc._pristine[1] is v2
+    finally:
+        svc.close()
