@@ -9,10 +9,23 @@ than ``threshold`` (relative), recommend retraining, and always render a
 report figure (raw series + rolling mean + shaded baseline/recent spans).
 
 Differences from the reference: the result is a structured
-:class:`DriftReport` (the reference only prints), and the retraining
+:class:`DriftReport` (the reference only prints), the retraining
 recommendation can directly drive ``workflows.retraining`` instead of asking
 a human to run it (closing the loop the reference leaves manual --
-SURVEY.md section 3.5).
+SURVEY.md section 3.5), and the decision rule is shared with the ONLINE
+monitor (monitoring/profile.py): on top of the reference's relative-mean
+test, the baseline and recent halves are compared as distributions (PSI /
+Jensen-Shannon over :class:`~..observability.sketch.StreamingSketch`
+histograms) with the same scoring code the serving-side ``DriftMonitor``
+runs, so the offline CSV verdict and the live ``/debug/drift`` verdict
+agree on the same traffic.
+
+Robustness (ISSUE 9 satellite): a malformed or truncated CSV row (a
+half-written last line from a killed server, a non-numeric cell) used to
+poison the means as NaN or raise out of ``astype(float)``; the column is
+now coerced with ``errors="coerce"``, non-finite rows are dropped and
+counted in ``DriftReport`` (``n_dropped`` + the reason string), and the
+min-rows gate applies to the VALID rows.
 """
 
 from __future__ import annotations
@@ -22,10 +35,16 @@ from pathlib import Path
 
 import numpy as np
 
+from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
+from robotic_discovery_platform_tpu.observability.sketch import StreamingSketch
 from robotic_discovery_platform_tpu.utils.config import DriftConfig
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
+
+#: The CSV column's declared range, matching the online monitor's
+#: ``SERVING_SIGNALS["mask_coverage"]`` so both paths bin identically.
+_COVERAGE_SPEC = profile_lib.SERVING_SIGNALS["mask_coverage"]
 
 
 @dataclass
@@ -38,6 +57,11 @@ class DriftReport:
     n_rows: int
     report_path: str | None
     reason: str
+    # distribution scores (shared with the online monitor); defaults keep
+    # positional construction at the legacy eight-field arity working
+    psi: float = 0.0
+    js: float = 0.0
+    n_dropped: int = 0
 
 
 def analyze_drift(cfg: DriftConfig = DriftConfig(),
@@ -49,21 +73,44 @@ def analyze_drift(cfg: DriftConfig = DriftConfig(),
         return DriftReport(False, False, 0.0, 0.0, 0.0, 0, None,
                            f"no metrics log at {path}")
     df = pd.read_csv(path)
-    n = len(df)
+    n_raw = len(df)
+    # a truncated last line or a non-numeric cell must not poison the
+    # means (NaN) or raise: coerce, then keep only finite rows
+    if "mask_coverage_percent" not in df.columns:
+        return DriftReport(
+            False, False, 0.0, 0.0, 0.0, 0, None,
+            f"{path} has no mask_coverage_percent column", n_dropped=n_raw,
+        )
+    col = pd.to_numeric(df["mask_coverage_percent"], errors="coerce")
+    col = col[np.isfinite(col)].astype(float)
+    n = len(col)
+    n_dropped = n_raw - n
+    dropped_note = (
+        f" ({n_dropped} malformed/non-finite row(s) dropped)"
+        if n_dropped else ""
+    )
     if n < cfg.min_rows:
         return DriftReport(
             False, False, 0.0, 0.0, 0.0, n, None,
-            f"only {n} rows (< {cfg.min_rows}); not enough data",
+            f"only {n} valid rows (< {cfg.min_rows}); not enough "
+            f"data{dropped_note}",
+            n_dropped=n_dropped,
         )
 
     split = int(n * cfg.baseline_fraction)
-    col = df["mask_coverage_percent"].astype(float)
     baseline = col.iloc[:split]
     recent = col.iloc[split:]
     b_mean = float(baseline.mean())
     r_mean = float(recent.mean())
     change = abs(r_mean - b_mean) / max(abs(b_mean), 1e-9)
-    drifted = change > cfg.threshold
+    # the same scoring code the online DriftMonitor runs per window:
+    # baseline-vs-recent as distributions over the shared binning
+    lo, hi, bins = _COVERAGE_SPEC
+    score = profile_lib.score_sketches(
+        StreamingSketch.from_values(lo, hi, bins, baseline.to_numpy()),
+        StreamingSketch.from_values(lo, hi, bins, recent.to_numpy()),
+    )
+    drifted = change > cfg.threshold or score.exceeds(cfg.psi_threshold)
 
     report_path = None
     if render:
@@ -71,7 +118,9 @@ def analyze_drift(cfg: DriftConfig = DriftConfig(),
 
     reason = (
         f"mask coverage mean moved {change:.1%} "
-        f"({b_mean:.2f} -> {r_mean:.2f}); threshold {cfg.threshold:.0%}"
+        f"({b_mean:.2f} -> {r_mean:.2f}); threshold {cfg.threshold:.0%}; "
+        f"psi {score.psi:.3f} (threshold {cfg.psi_threshold} + noise "
+        f"floor {score.noise_floor:.3f}), js {score.js:.3f}{dropped_note}"
     )
     if drifted:
         log.warning("DRIFT DETECTED: %s -- recommend running the retraining "
@@ -79,7 +128,8 @@ def analyze_drift(cfg: DriftConfig = DriftConfig(),
     else:
         log.info("no drift: %s", reason)
     return DriftReport(True, drifted, b_mean, r_mean, change, n, report_path,
-                       reason)
+                       reason, psi=score.psi, js=score.js,
+                       n_dropped=n_dropped)
 
 
 def _render_report(cfg: DriftConfig, series, split: int,

@@ -13,6 +13,11 @@ down with it. It serves:
 - ``GET /debug/spans`` -- the flight recorder's recent + pinned dispatch
   timelines as JSON (observability/recorder.py);
 - ``GET /debug/tracez`` -- the tracez-style per-span-name rollup;
+- ``GET /debug/drift`` -- the online drift monitor's state as JSON
+  (live vs reference histograms, per-signal PSI/JS scores, the
+  recommendation ladder; monitoring/profile.py). The serving layer
+  installs the provider via :meth:`MetricsServer.set_drift_provider`;
+  without one the endpoint reports ``{"enabled": false}``;
 - ``GET /debug/profile?seconds=N`` -- an on-demand ``jax.profiler``
   capture into ``RDP_PROFILE_DIR`` (409 when unset or a capture is
   already running), so a TPU profile can be pulled from a live server
@@ -100,11 +105,16 @@ class MetricsServer:
     def __init__(self, port: int, registry: MetricsRegistry = REGISTRY,
                  host: str = "0.0.0.0",
                  flight_recorder: "recorder_lib.FlightRecorder | None" = None,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 drift_provider=None):
         self._registry = registry
         self._recorder = (flight_recorder if flight_recorder is not None
                           else recorder_lib.RECORDER)
         self._profile_dir = profile_dir
+        # () -> JSON-able dict; installed after construction by the
+        # serving layer (the servicer owns the DriftMonitor and is built
+        # after the endpoint starts)
+        self._drift_provider = drift_provider
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -121,12 +131,22 @@ class MetricsServer:
                     self._send_json(outer._recorder.snapshot())
                 elif path == "/debug/tracez":
                     self._send_json(outer._recorder.summary())
+                elif path == "/debug/drift":
+                    provider = outer._drift_provider
+                    if provider is None:
+                        self._send_json({
+                            "enabled": False,
+                            "reason": "no drift monitor attached "
+                                      "(ServerConfig.drift_enabled)",
+                        })
+                    else:
+                        self._send_json(provider())
                 elif path == "/debug/profile":
                     self._profile(query)
                 else:
                     self.send_error(
                         404, "try /metrics, /debug/spans, /debug/tracez, "
-                             "or /debug/profile?seconds=N")
+                             "/debug/drift, or /debug/profile?seconds=N")
 
             def _send_json(self, payload: dict, status: int = 200):
                 body = json.dumps(payload, indent=1).encode("utf-8")
@@ -179,6 +199,11 @@ class MetricsServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def set_drift_provider(self, provider) -> None:
+        """Install (or clear) the ``GET /debug/drift`` payload source: a
+        zero-arg callable returning a JSON-able dict."""
+        self._drift_provider = provider
 
     def start(self) -> "MetricsServer":
         if self._thread is None:

@@ -94,6 +94,46 @@ SLO_BURN = REGISTRY.gauge(
     ("objective",),
 )
 
+# -- drift observability (monitoring/profile.py; ServerConfig.drift_*) -------
+
+DRIFT_SCORE = REGISTRY.gauge(
+    "rdp_drift_score",
+    "Live-vs-reference population stability index (PSI) per monitored "
+    "serving signal (mask_coverage, mean_curvature, max_curvature, "
+    "depth_valid_fraction, confidence_margin), rescored every "
+    "ServerConfig.drift_score_every frames over the sliding live window. "
+    "Sustained values above ServerConfig.drift_psi_threshold fire a "
+    "retrain recommendation.",
+    ("signal",),
+)
+DRIFT_RECOMMENDATIONS = REGISTRY.counter(
+    "rdp_drift_recommendations_total",
+    "Structured retrain recommendations fired by the online drift "
+    "monitor (hysteresis-gated: one per sustained excursion; each is "
+    "also pinned in the flight recorder and visible in /debug/drift).",
+)
+DRIFT_REFERENCE_AGE = REGISTRY.gauge(
+    "rdp_drift_reference_age_seconds",
+    "Age of the drift monitor's reference profile (registry artifact or "
+    "self-baseline); re-stamped when a hot-reload adopts a new "
+    "generation's profile. -1 while no reference exists yet "
+    "(self-baselining in progress).",
+)
+MODEL_CONFIDENCE_MARGIN = REGISTRY.histogram(
+    "rdp_model_confidence_margin",
+    "Per-frame segmentation confidence margin: mean |sigmoid(logit) - "
+    "0.5| over the model-resolution output (0 = maximally uncertain, "
+    "0.5 = saturated). A drop is the classic early signal of the model "
+    "leaving its training distribution.",
+    buckets=(0.05, 0.1, 0.15, 0.2, 0.25, 0.3, 0.35, 0.4, 0.45, 0.5),
+)
+METRICS_ROWS_SKIPPED = REGISTRY.counter(
+    "rdp_metrics_rows_skipped_total",
+    "Non-finite per-frame metric rows (nan/inf curvature or coverage) "
+    "skipped by the CSV MetricsWriter instead of being written into the "
+    "log the offline drift detector consumes.",
+)
+
 # -- batching ----------------------------------------------------------------
 
 BATCH_QUEUE_DEPTH = REGISTRY.gauge(

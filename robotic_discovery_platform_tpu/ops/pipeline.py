@@ -31,6 +31,12 @@ class FrameAnalysis(NamedTuple):
     mask: jnp.ndarray  # [(B,) H, W] uint8 native-resolution binary mask
     mask_coverage: jnp.ndarray  # [(B,)] percent of frame covered
     profile: geometry.CurvatureProfile  # leaves have a leading B in batch mode
+    # [(B,)] mean |sigmoid(logit) - 0.5| over the model-resolution output:
+    # how far the segmenter sits from its decision boundary (0 = maximally
+    # uncertain, 0.5 = saturated). Free at serving time -- the logits are
+    # already in the graph -- and the drift monitor's model-quality signal
+    # (monitoring/profile.py).
+    confidence_margin: jnp.ndarray
 
 
 @functools.lru_cache(maxsize=None)
@@ -143,6 +149,13 @@ def _analyze_batch(model, variables, frames_rgb, depths, intrinsics,
     else:
         logits = forward(variables, x)
     masks = logits_to_native_masks(logits, h, w, threshold)
+    # distance from the decision boundary, at model resolution (XLA CSEs
+    # the sigmoid with the one inside logits_to_native_masks; the extra
+    # cost is one [B, S, S] mean riding the existing result fetch)
+    margin = jnp.mean(
+        jnp.abs(jax.nn.sigmoid(logits[..., 0].astype(jnp.float32)) - 0.5),
+        axis=(1, 2),
+    )
 
     # The vmapped (dense-batch) leg pins the geometry kernels to the XLA
     # path: batching a pallas_call multiplies its VMEM working set by B
@@ -172,7 +185,8 @@ def _analyze_batch(model, variables, frames_rgb, depths, intrinsics,
             lambda m, d, k, s: per_frame(m, d, k, s, geom_cfg_vmap)
         )(masks, depths, intrinsics, depth_scales)
     coverage = 100.0 * jnp.mean(masks.astype(jnp.float32), axis=(1, 2))
-    return FrameAnalysis(mask=masks, mask_coverage=coverage, profile=profs)
+    return FrameAnalysis(mask=masks, mask_coverage=coverage, profile=profs,
+                         confidence_margin=margin)
 
 
 def make_frame_analyzer(

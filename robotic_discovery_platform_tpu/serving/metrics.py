@@ -7,15 +7,26 @@ detector consumes exactly these columns. Two reference defects fixed
 (SURVEY.md section 5.2): the reference re-opens the file for every frame and
 interleaves appends from up to 10 gRPC worker threads with no lock; here a
 single writer object owns the handle, buffers rows, and flushes under a lock.
+
+A third defect fixed here (ISSUE 9 satellite): an invalid frame's
+``nan``/``inf`` curvature used to be appended verbatim, poisoning the CSV
+the drift detector consumes (its column means went NaN). Non-finite rows
+are now skipped with a warning and counted
+(``rdp_metrics_rows_skipped_total``); ``skipped_rows`` exposes the count.
 """
 
 from __future__ import annotations
 
 import atexit
+import math
 import threading
 import time
 from datetime import datetime, timezone
 from pathlib import Path
+
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 HEADER = "timestamp,mean_curvature,max_curvature,mask_coverage_percent"
 
@@ -34,12 +45,30 @@ class MetricsWriter:
         # interval flushes must survive a server exit, so the tail is
         # flushed at interpreter shutdown unless close() already ran.
         self._closed = False
+        self.skipped_rows = 0
         atexit.register(self._flush_at_exit)
         if not self.path.exists():
             self.path.write_text(HEADER + "\n")
 
     def append(self, mean_curvature: float, max_curvature: float,
                mask_coverage_percent: float, timestamp: str | None = None) -> None:
+        values = (mean_curvature, max_curvature, mask_coverage_percent)
+        if not all(math.isfinite(float(v)) for v in values):
+            # an invalid frame's nan/inf must never reach the CSV the
+            # drift detector consumes; count it instead of writing it
+            with self._lock:
+                self.skipped_rows += 1
+            from robotic_discovery_platform_tpu.observability import (
+                instruments as obs,
+            )
+
+            obs.METRICS_ROWS_SKIPPED.inc()
+            log.warning(
+                "skipping non-finite metrics row "
+                "(mean_curvature=%s, max_curvature=%s, coverage=%s); "
+                "%d skipped so far", *values, self.skipped_rows,
+            )
+            return
         ts = timestamp or datetime.now(timezone.utc).strftime(
             "%Y-%m-%d %H:%M:%S.%f"
         )

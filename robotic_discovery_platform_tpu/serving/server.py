@@ -54,6 +54,25 @@ Observability (observability/ package):
   ServerConfig.slo_ms / RDP_SLO_MS sets an objective every frame feeds
   the SLO tracker (``rdp_slo_violations_total``, error-budget burn).
 
+Drift observability (monitoring/profile.py):
+
+- every OK/degraded frame's free signals -- mask coverage, mean/max
+  curvature, depth-validity fraction, segmentation confidence margin
+  (mean |sigmoid-0.5|, computed inside the fused graph) -- feed an online
+  DriftMonitor: per-signal sliding windows scored (PSI / Jensen-Shannon)
+  against a reference profile loaded from
+  ``ServerConfig.drift_profile_path`` / ``RDP_DRIFT_PROFILE``, the served
+  registry version's ``drift_profile.json`` artifact, or a self-baseline
+  over the first frames; hot-reload re-stamps the reference for the new
+  generation;
+- sustained scores above ``drift_psi_threshold`` fire ONE structured
+  retrain recommendation per excursion (sustain + cooldown hysteresis):
+  counted (``rdp_drift_recommendations_total``), pinned in the flight
+  recorder, and surfaced -- with live-vs-reference histograms and
+  per-signal scores -- at ``GET /debug/drift``;
+- all of it is host-side Python bookkeeping off the compute path: the
+  f32 serial bitwise-parity guarantee and the jit cache are untouched.
+
 Overload control (serving/admission.py, serving/controller.py):
 
 - the dispatcher's backlog is deadline-aware: at the cap the queued
@@ -74,6 +93,7 @@ Overload control (serving/admission.py, serving/controller.py):
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from concurrent import futures
@@ -85,6 +105,7 @@ import numpy as np
 
 from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.io.frames import load_calibration
+from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
 from robotic_discovery_platform_tpu.observability import (
     exposition,
     instruments as obs,
@@ -175,6 +196,20 @@ def resolve_serving_model(cfg: ServerConfig):
 def _default_intrinsics(w: int, h: int) -> np.ndarray:
     f = 0.94 * w
     return np.array([[f, 0, w / 2], [0, f, h / 2], [0, 0, 1]], np.float64)
+
+
+class _FrameResult(NamedTuple):
+    """One analyzed frame's host-side outputs (response fields + the
+    drift-monitor signals the frame already computed)."""
+
+    mean_k: float
+    max_k: float
+    spline: np.ndarray
+    mask_png: bytes
+    coverage: float
+    valid: bool
+    confidence_margin: float
+    depth_valid_fraction: float
 
 
 class Engine(NamedTuple):
@@ -314,6 +349,30 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             )
             log.info("SLO tracking: %.1f ms objective, %.2f%% budget",
                      slo_ms, 100 * cfg.slo_budget)
+        # Online drift monitor (monitoring/profile.py): every served
+        # frame's free signals feed per-signal sliding windows scored
+        # against a reference profile (registry artifact / explicit path /
+        # self-baseline). Strictly host-side deque+histogram bookkeeping
+        # OFF the compute path -- no device transfers, no jit retraces --
+        # so the f32 serial bitwise-parity guarantee is untouched.
+        self.drift: profile_lib.DriftMonitor | None = None
+        if cfg.drift_enabled:
+            reference = self._load_drift_profile(version)
+            self.drift = profile_lib.DriftMonitor(
+                reference=reference,
+                window=cfg.drift_window,
+                baseline_frames=cfg.drift_baseline_frames,
+                score_every=cfg.drift_score_every,
+                psi_threshold=cfg.drift_psi_threshold,
+                sustain_s=cfg.drift_sustain_s,
+                cooldown_s=cfg.drift_cooldown_s,
+                generation=version,
+                on_score=self._on_drift_score,
+                on_recommendation=self._on_drift_recommendation,
+            )
+            obs.DRIFT_REFERENCE_AGE.set(
+                -1.0 if reference is None else reference.age_s
+            )
         # Reactive SLO controller (serving/controller.py): consumes the
         # tracker's burn signal and retunes the LIVE engine's dispatcher
         # (the indirection follows hot-reload swaps). Needs an objective
@@ -359,6 +418,95 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             f"rdp.serving.chip.{chip}",
             health_lib.SERVING if serving else health_lib.NOT_SERVING,
         )
+
+    # -- drift observability ------------------------------------------------
+
+    def _load_drift_profile(
+            self, version: int | None) -> profile_lib.FeatureProfile | None:
+        """Resolve the reference profile: an explicit path
+        (cfg.drift_profile_path / RDP_DRIFT_PROFILE) wins, else the
+        ``drift_profile.json`` artifact next to the served registry
+        version's weights; None means self-baseline."""
+        path = profile_lib.resolve_drift_profile_path(
+            self.cfg.drift_profile_path
+        )
+        if path is not None:
+            try:
+                return profile_lib.FeatureProfile.load(path)
+            except Exception as exc:
+                log.warning(
+                    "drift profile %s unusable (%s: %s); falling back to "
+                    "registry artifact / self-baseline",
+                    path, type(exc).__name__, exc,
+                )
+        if version is None:
+            return None
+        try:
+            artifact = (
+                self._registry_store.version_path(
+                    self.cfg.model_name, version
+                ) / profile_lib.DRIFT_PROFILE_FILE
+            )
+            if artifact.exists():
+                return profile_lib.FeatureProfile.load(artifact)
+        except Exception as exc:
+            log.warning(
+                "no drift profile artifact for %s v%s (%s: %s); "
+                "self-baselining", self.cfg.model_name, version,
+                type(exc).__name__, exc,
+            )
+        return None
+
+    def _on_drift_score(self, signal: str,
+                        score: profile_lib.DriftScore) -> None:
+        obs.DRIFT_SCORE.labels(signal=signal).set(score.psi)
+        if self.drift is not None:
+            age = self.drift.reference_age_s
+            obs.DRIFT_REFERENCE_AGE.set(-1.0 if age is None else age)
+
+    def _on_drift_recommendation(
+            self, rec: profile_lib.RetrainRecommendation) -> None:
+        """Hysteresis-gated: at most one of these per sustained excursion.
+        Counted, pinned in the flight recorder (a recommendation is
+        evidence that must survive ring wrap-around), and logged -- PR
+        10's trigger wiring consumes the same structured object."""
+        obs.DRIFT_RECOMMENDATIONS.inc()
+        recorder_lib.RECORDER.pin(recorder_lib.RECORDER.record_event(
+            "serving.drift_recommendation",
+            signals=",".join(rec.signals),
+            generation=str(rec.generation),
+            reference=rec.reference_source,
+            reason=rec.reason,
+        ))
+        log.warning(
+            "DRIFT: %s -- recommend retraining (workflows.retraining)",
+            rec.reason,
+        )
+
+    def _rebaseline_drift(self, version: int | None) -> None:
+        """Hot-reload hook: the swapped-in generation gets its own
+        reference -- the new version's profile artifact when it shipped
+        one, else a fresh self-baseline -- re-stamping the reference
+        generation either way."""
+        if self.drift is None:
+            return
+        reference = self._load_drift_profile(version)
+        if reference is not None:
+            self.drift.set_reference(reference)
+            obs.DRIFT_REFERENCE_AGE.set(reference.age_s)
+        else:
+            self.drift.rebaseline(generation=version)
+            obs.DRIFT_REFERENCE_AGE.set(-1.0)
+
+    def drift_debug(self) -> dict:
+        """The ``GET /debug/drift`` payload."""
+        if self.drift is None:
+            return {"enabled": False,
+                    "reason": "drift monitoring disabled "
+                              "(ServerConfig.drift_enabled)"}
+        snap = self.drift.snapshot()
+        snap["model_version"] = self.current_version
+        return snap
 
     @property
     def variables(self):
@@ -574,11 +722,32 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             max_k = float(prof.max_curvature) if valid else 0.0
             spline = (np.asarray(prof.spline_points) if valid
                       else np.zeros((0, 3)))
+            # drift signals the frame already paid for: the margin rides
+            # the fused graph's result fetch, the depth-validity fraction
+            # is one host-side count over the raw depth frame
+            margin = float(np.asarray(out.confidence_margin))
+            depth_valid = float(np.count_nonzero(depth)) / max(depth.size, 1)
         with timer.stage("encode"):
             ok, mask_png = cv2.imencode(".png", mask * 255)
         if not ok:
             raise ValueError("mask encode failed")
-        return mean_k, max_k, spline, mask_png.tobytes(), coverage, valid
+        return _FrameResult(mean_k, max_k, spline, mask_png.tobytes(),
+                            coverage, valid, margin, depth_valid)
+
+    def _observe_drift(self, res: _FrameResult) -> None:
+        """Feed one analyzed frame's signals to the drift monitor and the
+        confidence-margin histogram -- pure host-side Python, after the
+        response is already built."""
+        obs.MODEL_CONFIDENCE_MARGIN.observe(res.confidence_margin)
+        if self.drift is None:
+            return
+        self.drift.observe_frame({
+            "mask_coverage": res.coverage,
+            "mean_curvature": res.mean_k if res.valid else math.nan,
+            "max_curvature": res.max_k if res.valid else math.nan,
+            "depth_valid_fraction": res.depth_valid_fraction,
+            "confidence_margin": res.confidence_margin,
+        })
 
     def _enter_stream(self) -> bool:
         with self._streams_cond:
@@ -660,23 +829,23 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 try:
                     with timer.stage("decode"):
                         color, depth = self._decode(request)
-                    mean_k, max_k, spline, mask_png, coverage, valid = (
-                        self._analyze_frame(color, depth, timer,
-                                            timeout_s=remaining)
-                    )
+                    res = self._analyze_frame(color, depth, timer,
+                                              timeout_s=remaining)
                     response = vision_pb2.AnalysisResponse(
-                        mean_curvature=mean_k,
-                        max_curvature=max_k,
+                        mean_curvature=res.mean_k,
+                        max_curvature=res.max_k,
                         spline_points=[
                             vision_pb2.Point3D(x=float(p[0]), y=float(p[1]), z=float(p[2]))
-                            for p in spline
+                            for p in res.spline
                         ],
-                        status="OK" if valid else "DEGRADED: insufficient geometry",
-                        mask=mask_png,
-                        mask_coverage=coverage,
+                        status="OK" if res.valid
+                               else "DEGRADED: insufficient geometry",
+                        mask=res.mask_png,
+                        mask_coverage=res.coverage,
                     )
-                    self.metrics.append(mean_k, max_k, coverage)
-                    status_label = "ok" if valid else "degraded"
+                    self.metrics.append(res.mean_k, res.max_k, res.coverage)
+                    self._observe_drift(res)
+                    status_label = "ok" if res.valid else "degraded"
                 except OverloadedError as exc:
                     # load shedding is a STREAM-level, retryable condition:
                     # surface the standard backpressure status instead of a
@@ -855,6 +1024,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     break
             log.info("hot-reloaded model: version %s -> %s",
                      old.version, version)
+            # the new generation gets its own drift reference (its
+            # profile artifact, or a fresh self-baseline): live-window
+            # scores against the OLD model's reference say nothing about
+            # the model now serving
+            self._rebaseline_drift(version)
             return True
         finally:
             # never went live (error, closed mid-build/-warm, or the swap
@@ -1110,6 +1284,10 @@ def build_server(
     servicer.metrics_server = exposition.maybe_start_metrics_server(
         cfg.metrics_port
     )
+    if servicer.metrics_server is not None:
+        # /debug/drift serves the monitor's live state (histograms,
+        # scores, recommendation ladder) next to /debug/spans
+        servicer.metrics_server.set_drift_provider(servicer.drift_debug)
     if warmup_shape is not None:
         servicer.warmup(*warmup_shape)  # flips readiness at the end
     else:

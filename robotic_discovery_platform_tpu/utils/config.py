@@ -329,6 +329,34 @@ class ServerConfig:
     controller_burn_low: float = 0.5
     # AIMD ceiling for the controller's additive max_inflight increases.
     controller_inflight_cap: int = 8
+    # -- drift observability (monitoring/profile.py) ------------------------
+    # Online input/prediction drift monitoring: every served frame's free
+    # signals (mask coverage, curvatures, depth-validity fraction,
+    # segmentation confidence margin) feed per-signal sliding windows
+    # scored (PSI / Jensen-Shannon) against a reference profile. Strictly
+    # host-side bookkeeping off the compute path.
+    drift_enabled: bool = True
+    # Reference profile JSON (monitoring/profile.FeatureProfile). Empty =
+    # look for drift_profile.json next to the served registry version's
+    # weights, else self-baseline on the first drift_baseline_frames
+    # frames. The RDP_DRIFT_PROFILE env var overrides this value.
+    drift_profile_path: str = ""
+    # Sliding live window (frames) each signal is scored over.
+    drift_window: int = 256
+    # Self-baseline size when no reference profile is available.
+    drift_baseline_frames: int = 64
+    # Recompute the divergence scores every N observed frames (scoring
+    # rebuilds five small histograms; per-frame work is deque appends).
+    drift_score_every: int = 16
+    # PSI above this counts a signal as drifted (0.25 = the conventional
+    # "major shift" boundary; matches DriftConfig.psi_threshold).
+    drift_psi_threshold: float = 0.25
+    # Hysteresis (mirrors the controller's brownout ladder): a signal
+    # must hold above threshold this long before a retrain recommendation
+    # fires, and after one fires the monitor stays disarmed until every
+    # signal recovers AND this cooldown elapses.
+    drift_sustain_s: float = 5.0
+    drift_cooldown_s: float = 300.0
     # -- chip quarantine (serving/batching.DeviceRouter) --------------------
     # Per-chip dispatch circuit breaker: after this many consecutive
     # dispatch failures on one mesh chip, that chip is quarantined
@@ -363,6 +391,11 @@ class DriftConfig:
     report_path: str = "reports/drift_report.png"
     rolling_window: int = 20
     report_dpi: int = 150
+    # Distribution-shift gate shared with the online monitor
+    # (monitoring/profile.py): baseline-vs-recent PSI above this ALSO
+    # flags drift, so a variance blowup with a stable mean is caught.
+    # 0.25 is the conventional "major shift" PSI boundary.
+    psi_threshold: float = 0.25
 
 
 @dataclass(frozen=True)

@@ -10,7 +10,13 @@ section 2.1 "retraining pipeline").
 
 Additions: the pipeline can be driven directly by the drift detector
 (``run_if_drifted``), closing the autonomous MLOps loop the reference
-describes but leaves manual (reference README.md:155-169).
+describes but leaves manual (reference README.md:155-169), and every
+promoted version ships a **drift reference profile**
+(``drift_profile.json`` next to its weights, monitoring/profile.py):
+the new model's serving-signal distributions captured over eval-set
+scenes, which the server's online DriftMonitor loads at startup and at
+hot-reload so live traffic is scored against the model that is actually
+serving.
 """
 
 from __future__ import annotations
@@ -18,6 +24,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
 from robotic_discovery_platform_tpu.utils.config import (
     DriftConfig,
     ModelConfig,
@@ -34,6 +41,53 @@ class PipelineResult:
     version: int | None
     promoted_alias: str | None
     message: str
+    drift_profile_path: str | None = None
+
+
+def capture_drift_profile(
+    version: int,
+    model_name: str = "Actuator-Segmenter",
+    tracking_uri: str | None = None,
+    n_frames: int = 16,
+    height: int = 120,
+    width: int = 160,
+    img_size: int = 256,
+    seed: int = 0,
+) -> str:
+    """Capture a :class:`~..monitoring.profile.FeatureProfile` for a
+    registered model version over synthetic eval scenes and store it as
+    ``drift_profile.json`` inside the version's artifact directory --
+    the training-time half of the online drift loop. Returns the saved
+    path."""
+    import numpy as np
+
+    from robotic_discovery_platform_tpu.training.synthetic import render_scene
+
+    store = (tracking.store_for(tracking_uri) if tracking_uri is not None
+             else None)
+    model, variables = tracking.load_model(
+        f"models:/{model_name}/{version}", store=store
+    )
+    rng = np.random.default_rng(seed)
+    frames = []
+    for _ in range(n_frames):
+        img, _, depth = render_scene(rng, height, width)
+        frames.append((img, depth))
+    profile = profile_lib.capture_feature_profile(
+        model, variables, frames, img_size=img_size, generation=version,
+    )
+    if store is None:
+        from robotic_discovery_platform_tpu.tracking.api import _store
+
+        store = _store()
+    dest = (store.version_path(model_name, version)
+            / profile_lib.DRIFT_PROFILE_FILE)
+    profile.save(dest)
+    log.info(
+        "drift reference profile for %s v%s captured over %d eval "
+        "frames -> %s", model_name, version, profile.n_frames, dest,
+    )
+    return str(dest)
 
 
 def run_retraining_pipeline(
@@ -57,12 +111,28 @@ def run_retraining_pipeline(
         client.set_registered_model_alias(
             cfg.registered_model_name, alias, latest.version
         )
+        # ship the drift reference with the promotion: the serving side
+        # scores live traffic against THIS version's eval-set signal
+        # distributions (failure is non-fatal -- the server self-baselines
+        # when a version has no profile)
+        profile_path = None
+        try:
+            profile_path = capture_drift_profile(
+                int(latest.version),
+                model_name=cfg.registered_model_name,
+                tracking_uri=cfg.tracking_uri,
+                img_size=cfg.img_size,
+            )
+        except Exception:
+            log.exception("drift-profile capture failed; the server will "
+                          "self-baseline this version")
         msg = (
             f"version {latest.version} of {cfg.registered_model_name!r} "
             f"promoted to @{alias} (val_loss {result.best_val_loss:.4f})"
         )
         log.info(msg)
-        return PipelineResult(True, latest.version, alias, msg)
+        return PipelineResult(True, latest.version, alias, msg,
+                              drift_profile_path=profile_path)
     except Exception as exc:
         # reference behavior: log, do not raise (retraining_pipeline.py:78-79)
         log.exception("retraining pipeline failed")

@@ -1,0 +1,544 @@
+"""Online drift observability: streaming sketches, divergence scoring,
+the DriftMonitor's hysteresis, profile save/load + capture, the
+/debug/drift endpoint, and the two CSV-path bugfixes (ISSUE 9)."""
+
+import json
+import math
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.monitoring import drift as drift_lib
+from robotic_discovery_platform_tpu.monitoring import profile as pl
+from robotic_discovery_platform_tpu.observability import exposition
+from robotic_discovery_platform_tpu.observability.registry import (
+    MetricsRegistry,
+)
+from robotic_discovery_platform_tpu.observability.sketch import (
+    StreamingSketch,
+)
+from robotic_discovery_platform_tpu.serving.metrics import (
+    HEADER,
+    MetricsWriter,
+)
+from robotic_discovery_platform_tpu.utils.config import DriftConfig
+
+# ---------------------------------------------------------------------------
+# StreamingSketch
+
+
+def test_sketch_moments_match_numpy(rng):
+    vals = rng.lognormal(0.0, 1.0, 500)
+    s = StreamingSketch(0.0, 50.0, 32)
+    s.observe_many(vals)
+    assert s.count == 500
+    assert s.mean == pytest.approx(float(np.mean(vals)), rel=1e-9)
+    assert s.variance == pytest.approx(float(np.var(vals)), rel=1e-9)
+    assert s.std == pytest.approx(float(np.std(vals)), rel=1e-9)
+
+
+def test_sketch_binning_and_overflow():
+    s = StreamingSketch(0.0, 10.0, 10)
+    s.observe_many([-1.0, 0.0, 0.5, 5.0, 9.999, 10.0, 42.0])
+    counts = s.counts()
+    assert counts[0] == 1  # underflow: -1
+    assert counts[1] == 2  # [0, 1): 0.0, 0.5
+    assert counts[6] == 1  # [5, 6)
+    assert counts[10] == 1  # [9, 10): 9.999
+    assert counts[11] == 2  # overflow: 10.0 (hi exclusive), 42
+    assert sum(counts) == s.count == 7
+    assert len(s.bin_edges()) == 11
+
+
+def test_sketch_non_finite_excluded():
+    s = StreamingSketch(0.0, 1.0, 4)
+    s.observe_many([0.5, math.nan, math.inf, -math.inf, 0.5])
+    assert s.count == 2
+    assert s.non_finite == 3
+    assert s.mean == pytest.approx(0.5)
+    assert sum(s.counts()) == 2
+
+
+def test_sketch_empty_reads():
+    s = StreamingSketch(0.0, 1.0, 4)
+    assert s.count == 0
+    assert math.isnan(s.mean) and math.isnan(s.variance)
+    # empty probabilities are uniform, so scoring two empties gives ~0
+    assert sum(s.probabilities()) == pytest.approx(1.0)
+
+
+def test_sketch_validation():
+    with pytest.raises(ValueError):
+        StreamingSketch(1.0, 1.0, 4)
+    with pytest.raises(ValueError):
+        StreamingSketch(0.0, math.inf, 4)
+    with pytest.raises(ValueError):
+        StreamingSketch(0.0, 1.0, 0)
+
+
+def test_sketch_merge_equals_combined_stream(rng):
+    a_vals = rng.uniform(0, 80, 300)
+    b_vals = rng.uniform(20, 100, 200)
+    a = StreamingSketch.from_values(0, 100, 16, a_vals)
+    b = StreamingSketch.from_values(0, 100, 16, b_vals)
+    b.observe(math.nan)
+    combined = StreamingSketch.from_values(
+        0, 100, 16, np.concatenate([a_vals, b_vals])
+    )
+    a.merge(b)
+    assert a.counts() == combined.counts()
+    assert a.count == combined.count
+    assert a.non_finite == 1
+    assert a.mean == pytest.approx(combined.mean, rel=1e-9)
+    assert a.variance == pytest.approx(combined.variance, rel=1e-9)
+
+
+def test_sketch_merge_rejects_mismatched_binning():
+    with pytest.raises(ValueError):
+        StreamingSketch(0, 1, 4).merge(StreamingSketch(0, 1, 8))
+
+
+def test_sketch_snapshot_restore_roundtrip(rng):
+    s = StreamingSketch.from_values(0, 10, 8, rng.uniform(-2, 14, 100))
+    s.observe(math.nan)
+    restored = StreamingSketch.restore(json.loads(json.dumps(s.snapshot())))
+    assert restored.snapshot() == s.snapshot()
+    # restored sketch keeps streaming correctly
+    restored.observe(5.0)
+    assert restored.count == s.count + 1
+
+
+def test_sketch_concurrent_observe():
+    s = StreamingSketch(0, 100, 16)
+
+    def work(seed):
+        r = np.random.default_rng(seed)
+        for v in r.uniform(0, 100, 500):
+            s.observe(float(v))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert s.count == 8 * 500
+    assert sum(s.counts()) == 8 * 500
+
+
+# ---------------------------------------------------------------------------
+# divergence scoring
+
+
+def test_psi_zero_for_identical_counts():
+    c = [0, 5, 10, 5, 0]
+    assert pl.psi(c, c) == pytest.approx(0.0)
+
+
+def test_psi_large_for_disjoint_shift(rng):
+    a = StreamingSketch.from_values(0, 100, 32, rng.uniform(10, 30, 400))
+    b = StreamingSketch.from_values(0, 100, 32, rng.uniform(70, 90, 400))
+    score = pl.score_sketches(a, b)
+    assert score.psi > 2.0
+    assert score.js > 0.9  # near-disjoint support
+    assert score.exceeds(0.25)
+
+
+def test_same_distribution_stays_under_noise_aware_gate(rng):
+    """The load-bearing property of the noise floor: finite same-
+    distribution windows must not flag (raw small-sample PSI alone
+    routinely exceeds 0.25 here)."""
+    flags = 0
+    for trial in range(40):
+        vals = rng.normal(45, 8, 64 + 128)
+        a = StreamingSketch.from_values(0, 100, 32, vals[:64])
+        b = StreamingSketch.from_values(0, 100, 32, vals[64:])
+        if pl.score_sketches(a, b).exceeds(0.25):
+            flags += 1
+    assert flags <= 4  # a few percent of per-score flicker at most
+
+
+def test_js_distance_bounds():
+    assert pl.js_distance([0.5, 0.5], [0.5, 0.5]) == pytest.approx(0.0)
+    assert pl.js_distance([1.0, 0.0], [0.0, 1.0]) == pytest.approx(1.0)
+    with pytest.raises(ValueError):
+        pl.js_distance([1.0], [0.5, 0.5])
+
+
+def test_score_sketches_rejects_mismatched_binning():
+    with pytest.raises(ValueError):
+        pl.score_sketches(StreamingSketch(0, 1, 4), StreamingSketch(0, 2, 4))
+
+
+# ---------------------------------------------------------------------------
+# FeatureProfile
+
+
+def test_profile_save_load_roundtrip(tmp_path, rng):
+    p = pl.FeatureProfile(generation=7)
+    for _ in range(50):
+        p.observe({
+            "mask_coverage": float(rng.uniform(30, 60)),
+            "depth_valid_fraction": float(rng.uniform(0.9, 1.0)),
+            "confidence_margin": float(rng.uniform(0.1, 0.3)),
+            "unknown_signal": 1.0,  # ignored, not an error
+        })
+    assert p.n_frames == 50
+    path = p.save(tmp_path / "sub" / "drift_profile.json")
+    loaded = pl.FeatureProfile.load(path)
+    assert loaded.generation == 7
+    assert loaded.n_frames == 50
+    assert set(loaded.sketches) == set(pl.SERVING_SIGNALS)
+    assert (loaded.sketches["mask_coverage"].snapshot()
+            == p.sketches["mask_coverage"].snapshot())
+    assert loaded.age_s >= 0.0
+
+
+def test_profile_env_resolver(monkeypatch):
+    assert pl.resolve_drift_profile_path("") is None
+    assert pl.resolve_drift_profile_path("a.json") == "a.json"
+    monkeypatch.setenv("RDP_DRIFT_PROFILE", "/env/wins.json")
+    assert pl.resolve_drift_profile_path("a.json") == "/env/wins.json"
+
+
+def test_capture_feature_profile_runs_the_analyzer():
+    import jax
+
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.training.synthetic import render_scene
+    from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = init_unet(model, jax.random.key(0), img_size=32)
+    r = np.random.default_rng(0)
+    frames = [render_scene(r, 48, 64)[::2] for _ in range(3)]
+    profile = pl.capture_feature_profile(
+        model, variables, frames, img_size=32, generation=3
+    )
+    assert profile.generation == 3
+    assert profile.n_frames == 3
+    assert set(profile.sketches) == set(pl.SERVING_SIGNALS)
+    # resolution-normalized signals landed in range
+    assert profile.sketches["depth_valid_fraction"].count == 3
+    assert profile.sketches["confidence_margin"].count == 3
+
+
+# ---------------------------------------------------------------------------
+# DriftMonitor (fake clock)
+
+
+def _monitor(clock, **kw):
+    defaults = dict(
+        signals={"x": pl.SignalSpec(0.0, 1.0, 16)},
+        window=64, baseline_frames=16, score_every=8, min_live=8,
+        psi_threshold=0.25, sustain_s=1.0, cooldown_s=10.0, clock=clock,
+    )
+    defaults.update(kw)
+    return pl.DriftMonitor(**defaults)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _feed(mon, clock, rng, lo, hi, n, dt=0.05):
+    recs = []
+    for _ in range(n):
+        clock.advance(dt)
+        r = mon.observe_frame({"x": float(rng.uniform(lo, hi))})
+        if r is not None:
+            recs.append(r)
+    return recs
+
+
+def test_monitor_self_baselines_then_scores(rng):
+    clock = _Clock()
+    mon = _monitor(clock)
+    assert _feed(mon, clock, rng, 0.2, 0.4, 16) == []
+    assert mon.reference is not None
+    assert mon.reference.source == "self-baseline"
+    assert mon.scores == {}  # baseline frames themselves are not scored
+    assert _feed(mon, clock, rng, 0.2, 0.4, 32) == []
+    assert "x" in mon.scores
+    assert not mon.scores["x"].exceeds(mon.psi_threshold)
+    assert mon.recommendations_total == 0
+
+
+def test_monitor_fires_exactly_once_per_excursion(rng):
+    clock = _Clock()
+    scored, recd = [], []
+    mon = _monitor(clock, on_score=lambda n, s: scored.append((n, s)),
+                   on_recommendation=recd.append)
+    _feed(mon, clock, rng, 0.2, 0.4, 16)  # baseline
+    recs = _feed(mon, clock, rng, 0.7, 0.9, 120)  # sustained shift
+    assert len(recs) == 1
+    assert recs[0].signals == ["x"]
+    assert recs[0].scores["x"] > 0.25
+    assert recs[0].reference_source == "self-baseline"
+    assert "drift" in recs[0].reason
+    assert mon.recommendations_total == 1
+    assert recd == recs
+    assert scored and scored[-1][0] == "x"
+    # the recommendation is JSON-shaped for the recorder / endpoint
+    json.dumps(recs[0].to_dict())
+
+
+def test_monitor_rearms_after_recovery_and_cooldown(rng):
+    clock = _Clock()
+    mon = _monitor(clock)
+    _feed(mon, clock, rng, 0.2, 0.4, 16)
+    assert len(_feed(mon, clock, rng, 0.7, 0.9, 80)) == 1
+    # recovery: scores drop under threshold, cooldown elapses
+    _feed(mon, clock, rng, 0.2, 0.4, 80)
+    clock.advance(mon.cooldown_s)
+    # second excursion is a NEW event and may fire again
+    assert len(_feed(mon, clock, rng, 0.7, 0.9, 80)) == 1
+    assert mon.recommendations_total == 2
+
+
+def test_monitor_sustain_gates_a_spike(rng):
+    clock = _Clock()
+    # sustain longer than the whole spike: nothing may fire
+    mon = _monitor(clock, sustain_s=100.0)
+    _feed(mon, clock, rng, 0.2, 0.4, 16)
+    assert _feed(mon, clock, rng, 0.7, 0.9, 200) == []
+    assert mon.scores["x"].psi > 0.25  # scored over threshold...
+    assert mon.recommendations_total == 0  # ...but never sustained
+
+
+def test_monitor_invalid_signal_values_ignored(rng):
+    clock = _Clock()
+    mon = _monitor(clock)
+    _feed(mon, clock, rng, 0.2, 0.4, 16)
+    for _ in range(32):
+        clock.advance(0.05)
+        mon.observe_frame({"x": math.nan})  # invalid frames: no value
+    # nan observations never entered the live window
+    assert mon.snapshot()["signals"]["x"]["live"]["count"] == 0
+
+
+def test_monitor_rebaseline_restamps_generation(rng):
+    clock = _Clock()
+    mon = _monitor(clock, generation=1)
+    _feed(mon, clock, rng, 0.2, 0.4, 40)
+    assert mon.reference is not None
+    mon.rebaseline(generation=2)
+    assert mon.reference is None
+    assert mon.generation == 2
+    _feed(mon, clock, rng, 0.7, 0.9, 16)  # new baseline, new distribution
+    assert mon.reference is not None
+    assert mon.reference.generation == 2
+    # the new normal is the SHIFTED distribution now: no drift
+    assert _feed(mon, clock, rng, 0.7, 0.9, 40) == []
+
+
+def test_monitor_set_reference_resets_windows(rng):
+    clock = _Clock()
+    mon = _monitor(clock)
+    _feed(mon, clock, rng, 0.2, 0.4, 60)
+    ref = pl.FeatureProfile({"x": pl.SignalSpec(0.0, 1.0, 16)},
+                            generation=9, source="capture")
+    for _ in range(64):
+        ref.observe({"x": float(rng.uniform(0.7, 0.9))})
+    mon.set_reference(ref)
+    assert mon.frames_observed == 0
+    assert mon.reference.generation == 9
+    # live traffic now diverges from the ADOPTED reference
+    assert len(_feed(mon, clock, rng, 0.2, 0.4, 120)) == 1
+
+
+def test_monitor_snapshot_is_json_ready(rng):
+    clock = _Clock()
+    mon = _monitor(clock)
+    snap = mon.snapshot()
+    assert snap["state"] == "baselining"
+    _feed(mon, clock, rng, 0.2, 0.4, 60)
+    snap = json.loads(json.dumps(mon.snapshot()))
+    assert snap["state"] == "scoring"
+    sig = snap["signals"]["x"]
+    assert sig["psi"] is not None and sig["noise_floor"] is not None
+    assert sig["reference"]["count"] == 16
+    assert sig["live"]["count"] > 0
+    assert snap["recommendations"] == {
+        "count": 0, "armed": True, "last": None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# offline detector bugfix + shared scoring
+
+
+def _write_csv(path, coverages, extra_lines=()):
+    rows = [HEADER] + [
+        f"2026-01-01 00:00:{i % 60:02d}.0,0.1,0.2,{c}"
+        for i, c in enumerate(coverages)
+    ] + list(extra_lines)
+    path.write_text("\n".join(rows) + "\n")
+
+
+def test_analyze_drift_coerces_malformed_rows(tmp_path):
+    csv = tmp_path / "m.csv"
+    _write_csv(
+        csv, [50.0] * 30 + [51.0] * 30,
+        extra_lines=[
+            "2026-01-01 00:01:00.0,0.1,0.2,not-a-number",
+            "2026-01-01 00:01:01.0,0.1,0.2,nan",
+            "2026-01-01 00:01:02.0,0.1",  # truncated last line
+        ],
+    )
+    rep = drift_lib.analyze_drift(
+        DriftConfig(metrics_csv=str(csv)), render=False
+    )
+    assert rep.analyzed and not rep.drifted
+    assert rep.n_rows == 60  # only the valid rows
+    assert rep.n_dropped == 3
+    assert "3 malformed" in rep.reason
+    assert np.isfinite(rep.baseline_mean) and np.isfinite(rep.recent_mean)
+
+
+def test_analyze_drift_truncated_last_line_regression(tmp_path):
+    """A server killed mid-flush leaves a partial final row; that row
+    used to become NaN and poison both means."""
+    csv = tmp_path / "m.csv"
+    _write_csv(csv, [50.0] * 60)
+    with open(csv, "a") as f:
+        f.write("2026-01-01 00:09:59.0,0.3")  # no newline, short row
+    rep = drift_lib.analyze_drift(
+        DriftConfig(metrics_csv=str(csv)), render=False
+    )
+    assert rep.analyzed and not rep.drifted
+    assert rep.n_dropped == 1
+    assert rep.baseline_mean == pytest.approx(50.0)
+
+
+def test_analyze_drift_all_garbage_not_analyzed(tmp_path):
+    csv = tmp_path / "m.csv"
+    rows = [HEADER] + ["2026-01-01,x,y,z"] * 60
+    csv.write_text("\n".join(rows) + "\n")
+    rep = drift_lib.analyze_drift(
+        DriftConfig(metrics_csv=str(csv)), render=False
+    )
+    assert not rep.analyzed
+    assert rep.n_dropped == 60
+
+
+def test_analyze_drift_psi_flags_variance_blowup(tmp_path, rng):
+    """The shared distribution scoring catches what the mean rule cannot:
+    same mean, exploded spread."""
+    csv = tmp_path / "m.csv"
+    stable = rng.normal(50, 1.5, 100).clip(0, 100)
+    blown = rng.uniform(5, 95, 100)  # same mean ~50, huge spread
+    _write_csv(csv, [f"{v:.3f}" for v in np.concatenate([stable, blown])])
+    rep = drift_lib.analyze_drift(
+        DriftConfig(metrics_csv=str(csv)), render=False
+    )
+    assert rep.relative_change < 0.25  # the mean rule alone is blind here
+    assert rep.drifted  # ...but the PSI gate fires
+    assert rep.psi > 0.25
+    assert rep.js > 0.0
+
+
+# ---------------------------------------------------------------------------
+# MetricsWriter non-finite bugfix
+
+
+def test_metrics_writer_skips_non_finite_rows(tmp_path):
+    from robotic_discovery_platform_tpu.observability import (
+        instruments as obs,
+    )
+
+    before = obs.METRICS_ROWS_SKIPPED.value
+    w = MetricsWriter(tmp_path / "m.csv", flush_every=1)
+    w.append(0.1, 0.2, 50.0)
+    w.append(math.nan, 0.2, 50.0)
+    w.append(0.1, math.inf, 50.0)
+    w.append(0.1, 0.2, -math.inf)
+    w.append(0.3, 0.4, 60.0)
+    w.close()
+    lines = (tmp_path / "m.csv").read_text().strip().splitlines()
+    assert lines[0] == HEADER
+    assert len(lines) == 3  # header + the two finite rows
+    assert all("nan" not in ln and "inf" not in ln for ln in lines)
+    assert w.skipped_rows == 3
+    assert obs.METRICS_ROWS_SKIPPED.value == before + 3
+
+
+# ---------------------------------------------------------------------------
+# /debug/drift endpoint
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as resp:
+        assert resp.headers["Content-Type"] == "application/json"
+        return json.loads(resp.read().decode())
+
+
+def test_debug_drift_endpoint_serves_provider_payload(rng):
+    srv = exposition.MetricsServer(0, MetricsRegistry(),
+                                   host="127.0.0.1").start()
+    try:
+        # no provider installed: enabled=false, still parseable JSON
+        assert _get_json(srv.port, "/debug/drift")["enabled"] is False
+        clock = _Clock()
+        mon = _monitor(clock)
+        _feed(mon, clock, rng, 0.2, 0.4, 60)
+        srv.set_drift_provider(mon.snapshot)
+        payload = _get_json(srv.port, "/debug/drift")
+        assert payload["enabled"] is True
+        assert payload["state"] == "scoring"
+        assert payload["signals"]["x"]["psi"] is not None
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# pipeline: the confidence-margin output
+
+
+def test_frame_analyzer_reports_confidence_margin(rng):
+    import jax
+
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.ops import pipeline
+    from robotic_discovery_platform_tpu.utils.config import (
+        GeometryConfig,
+        ModelConfig,
+    )
+
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = init_unet(model, jax.random.key(0), img_size=32)
+    analyze = pipeline.make_frame_analyzer(
+        model, img_size=32, geom_cfg=GeometryConfig()
+    )
+    frame = rng.integers(0, 255, (48, 64, 3), np.uint8)
+    depth = np.full((48, 64), 900, np.uint16)
+    k = np.eye(3, dtype=np.float32)
+    out = analyze(variables, frame, depth, k, np.float32(0.001))
+    margin = float(out.confidence_margin)
+    assert 0.0 <= margin <= 0.5
+    # batch path agrees with the single-frame path
+    batched = pipeline.make_batch_analyzer(
+        model, img_size=32, geom_cfg=GeometryConfig()
+    )(variables, frame[None], depth[None], k[None],
+      np.asarray([0.001], np.float32))
+    assert float(batched.confidence_margin[0]) == pytest.approx(
+        margin, abs=1e-5
+    )

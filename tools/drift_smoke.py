@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""CI drift smoke: the full online drift-signal path, end to end.
+
+Boots the real gRPC server (drift monitor self-baselining, metrics
+endpoint up), streams **nominal** synthetic frames through the real client
+and asserts every drift score stays under threshold with zero
+recommendations; then streams **distribution-shifted** frames (darkened
+images + degraded depth -- the input-shift scenario) and asserts:
+
+- per-signal ``rdp_drift_score`` rises above the PSI threshold,
+- exactly ONE sustained retrain recommendation fires (hysteresis gates
+  flapping: more shifted traffic must not fire a second one),
+- the recommendation is counted (``rdp_drift_recommendations_total``),
+  pinned in the flight recorder (``/debug/spans``), and visible in
+  ``GET /debug/drift``,
+- the OFFLINE detector (monitoring/drift.py) reaches the same verdict
+  from the same run's metrics CSV -- the two paths share their scoring.
+
+The served model's segmentation head is scaled/biased so its mask
+coverage is genuinely brightness-sensitive (a random init saturates to
+empty masks, which would hide the prediction-shift signals).
+
+Run: ``env JAX_PLATFORMS=cpu python tools/drift_smoke.py``. Exit 0 on
+success, 1 with a diagnostic.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+# runnable straight from a checkout, with or without `pip install -e .`
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+H, W = 120, 160
+BASELINE_FRAMES = 20
+NOMINAL_FRAMES = 56  # 20 self-baseline + 36 scored live frames
+SHIFT_FRAMES = 64
+EXTRA_SHIFT_FRAMES = 32  # hysteresis leg: must NOT fire a second rec
+
+
+class DriftSource:
+    """Synthetic camera whose distribution can be shifted mid-run:
+    ``shifted=True`` darkens the scene to 25% brightness and zeroes every
+    other depth row (sensor degradation)."""
+
+    def __init__(self, seed: int, n_frames: int, shifted: bool):
+        self.seed, self.n_frames, self.shifted = seed, n_frames, shifted
+        self._rng = np.random.default_rng(seed)
+        self._count = 0
+
+    def start(self) -> None:
+        self._rng = np.random.default_rng(self.seed)
+        self._count = 0
+
+    def stop(self) -> None:
+        pass
+
+    @property
+    def depth_scale(self) -> float:
+        return 0.001
+
+    def intrinsics(self) -> np.ndarray:
+        f = 0.94 * W
+        return np.array([[f, 0, W / 2], [0, f, H / 2], [0, 0, 1]],
+                        np.float64)
+
+    def get_frames(self):
+        from robotic_discovery_platform_tpu.training.synthetic import (
+            render_scene,
+        )
+
+        if self._count >= self.n_frames:
+            return None, None
+        self._count += 1
+        img_rgb, _, depth = render_scene(self._rng, H, W)
+        if self.shifted:
+            img_rgb = (img_rgb.astype(np.float32) * 0.25).astype(np.uint8)
+            depth = depth.copy()
+            depth[::2] = 0
+        return img_rgb[..., ::-1].copy(), depth  # BGR like a real camera
+
+
+def _get_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30
+    ) as resp:
+        return resp.read().decode()
+
+
+def _fail(msg: str, payload=None) -> int:
+    print(f"FAIL: {msg}")
+    if payload is not None:
+        print(json.dumps(payload, indent=1)[:4000])
+    return 1
+
+
+def main() -> int:
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        force_cpu_platform,
+    )
+
+    force_cpu_platform(min_devices=1)
+
+    import copy
+
+    import jax
+    from flax.core import unfreeze
+
+    from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.monitoring.drift import analyze_drift
+    from robotic_discovery_platform_tpu.serving import client as client_lib
+    from robotic_discovery_platform_tpu.serving import server as server_lib
+    from robotic_discovery_platform_tpu.utils.config import (
+        ClientConfig,
+        DriftConfig,
+        ModelConfig,
+        ServerConfig,
+    )
+
+    tmp = Path(tempfile.mkdtemp(prefix="rdp-drift-smoke-"))
+    uri = f"file:{tmp}/mlruns"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = unfreeze(
+        jax.device_get(init_unet(model, jax.random.key(0), img_size=64))
+    )
+    # brightness-sensitive head: logits straddle the 0.5 threshold, so
+    # darkening the input genuinely moves mask coverage AND the
+    # confidence margin (a raw random init saturates to empty masks)
+    v = copy.deepcopy(variables)
+    v["params"]["Conv_0"]["kernel"] = (
+        np.asarray(v["params"]["Conv_0"]["kernel"]) * 40.0
+    )
+    v["params"]["Conv_0"]["bias"] = np.full((1,), 0.5, np.float32)
+    with tracking.start_run():
+        version = tracking.log_model(
+            v, mcfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", version
+    )
+
+    csv = tmp / "metrics.csv"
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        model_img_size=64,
+        metrics_csv=str(csv),
+        metrics_flush_every=1,
+        calibration_path=str(tmp / "missing.npz"),
+        metrics_port=-1,  # ephemeral /metrics + /debug/* endpoint
+        reload_poll_s=0.0,
+        # fast drift knobs for a short smoke: small self-baseline, tight
+        # scoring stride, sub-second sustain, long cooldown (so a second
+        # recommendation inside this run can only mean broken hysteresis)
+        drift_baseline_frames=BASELINE_FRAMES,
+        drift_window=64,
+        drift_score_every=8,
+        drift_psi_threshold=0.25,
+        drift_sustain_s=0.2,
+        drift_cooldown_s=600.0,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    grpc_port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        if servicer.metrics_server is None:
+            return _fail("metrics server did not start")
+        port = servicer.metrics_server.port
+        ccfg = ClientConfig(server_address=f"localhost:{grpc_port}",
+                            calibration_path="none.npz")
+
+        # -- phase 1: nominal traffic --------------------------------------
+        client_lib.run_client(
+            ccfg, source=DriftSource(seed=1, n_frames=NOMINAL_FRAMES,
+                                     shifted=False),
+            max_frames=NOMINAL_FRAMES,
+        )
+        snap = _get_json(port, "/debug/drift")
+        if not snap.get("enabled") or snap.get("state") != "scoring":
+            return _fail("monitor not scoring after nominal phase", snap)
+        scored = {name: s for name, s in snap["signals"].items()
+                  if s["psi"] is not None}
+        if not scored:
+            return _fail("no signal was scored in the nominal phase", snap)
+        hot = {n: (s["psi"], s["noise_floor"]) for n, s in scored.items()
+               if s["above_threshold"]}
+        if hot:
+            return _fail(f"nominal traffic flagged over threshold: {hot}",
+                         snap)
+        if snap["recommendations"]["count"] != 0:
+            return _fail("recommendation fired on nominal traffic", snap)
+        print(f"nominal ok: {len(scored)} signals scored, none above "
+              f"threshold+floor (max psi "
+              f"{max(s['psi'] for s in scored.values()):.3f}), "
+              "0 recommendations")
+
+        # -- phase 2: shifted traffic --------------------------------------
+        client_lib.run_client(
+            ccfg, source=DriftSource(seed=2, n_frames=SHIFT_FRAMES,
+                                     shifted=True),
+            max_frames=SHIFT_FRAMES,
+        )
+        snap = _get_json(port, "/debug/drift")
+        drifted = {n: s["psi"] for n, s in snap["signals"].items()
+                   if s["above_threshold"]}
+        if not drifted:
+            return _fail("no signal crossed the PSI threshold under "
+                         "shifted traffic", snap)
+        if snap["recommendations"]["count"] != 1:
+            return _fail(
+                f"expected exactly 1 recommendation, got "
+                f"{snap['recommendations']['count']}", snap)
+        rec = snap["recommendations"]["last"]
+        if not rec or not rec["signals"]:
+            return _fail("recommendation carries no signals", snap)
+        print(f"shift ok: drifted={ {k: round(v, 3) for k, v in drifted.items()} }, "
+              f"1 recommendation on {rec['signals']}")
+
+        # -- phase 3: hysteresis (no second recommendation) ----------------
+        client_lib.run_client(
+            ccfg, source=DriftSource(seed=3, n_frames=EXTRA_SHIFT_FRAMES,
+                                     shifted=True),
+            max_frames=EXTRA_SHIFT_FRAMES,
+        )
+        snap = _get_json(port, "/debug/drift")
+        if snap["recommendations"]["count"] != 1:
+            return _fail(
+                f"hysteresis failed: {snap['recommendations']['count']} "
+                "recommendations after continued shift", snap)
+        print("hysteresis ok: continued shift fired no second "
+              "recommendation")
+
+        # -- exported metric families --------------------------------------
+        text = _scrape(port)
+        for family in ("rdp_drift_score", "rdp_drift_recommendations_total",
+                       "rdp_drift_reference_age_seconds",
+                       "rdp_model_confidence_margin"):
+            if f"# TYPE {family} " not in text:
+                return _fail(f"/metrics is missing {family}")
+        if "rdp_drift_recommendations_total 1" not in text:
+            return _fail("rdp_drift_recommendations_total != 1",
+                         [ln for ln in text.splitlines() if "drift" in ln])
+        score_lines = [ln for ln in text.splitlines()
+                       if ln.startswith("rdp_drift_score{")]
+        if not any(float(ln.rsplit(" ", 1)[1]) > cfg.drift_psi_threshold
+                   for ln in score_lines):
+            return _fail("no rdp_drift_score sample above threshold",
+                         score_lines)
+        print(f"metrics ok: {len(score_lines)} rdp_drift_score samples, "
+              "recommendation counted")
+
+        # -- the recommendation is pinned flight-recorder evidence ---------
+        spans = _get_json(port, "/debug/spans")
+        pinned = [t for t in spans.get("pinned", [])
+                  if t.get("name") == "serving.drift_recommendation"]
+        if len(pinned) != 1:
+            return _fail(
+                f"expected 1 pinned drift_recommendation timeline, got "
+                f"{len(pinned)}", spans.get("pinned"))
+        print("recorder ok: recommendation pinned in /debug/spans")
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+
+    # -- the offline path agrees from the same run's CSV -------------------
+    report = analyze_drift(
+        DriftConfig(metrics_csv=str(csv), min_rows=40,
+                    baseline_fraction=0.4), render=False,
+    )
+    if not (report.analyzed and report.drifted):
+        return _fail(f"offline analyze_drift disagrees: {report}")
+    print(f"offline ok: drifted=True from the same CSV "
+          f"(mean {report.baseline_mean:.1f} -> {report.recent_mean:.1f}, "
+          f"psi {report.psi:.3f}, {report.n_rows} rows, "
+          f"{report.n_dropped} dropped)")
+    print("DRIFT SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

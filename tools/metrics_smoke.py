@@ -12,6 +12,7 @@ Exit code 0 on success, 1 with a diagnostic on any missing family.
 
 from __future__ import annotations
 
+import json
 import shutil
 import subprocess
 import sys
@@ -33,6 +34,20 @@ REQUIRED_FAMILIES = (
     "rdp_slo_objective_seconds",
     "rdp_slo_violations_total",
     "rdp_slo_error_budget_burn",
+    # drift observability (PR 9)
+    "rdp_drift_score",
+    "rdp_drift_recommendations_total",
+    "rdp_drift_reference_age_seconds",
+    "rdp_model_confidence_margin",
+    "rdp_metrics_rows_skipped_total",
+)
+#: the signals the online drift monitor must expose in /debug/drift
+DRIFT_SIGNALS = (
+    "mask_coverage",
+    "mean_curvature",
+    "max_curvature",
+    "depth_valid_fraction",
+    "confidence_margin",
 )
 REQUIRED_SAMPLES = (
     'rdp_stage_latency_seconds_count{stage="total"}',
@@ -42,6 +57,8 @@ REQUIRED_SAMPLES = (
     'rdp_frame_latency_summary_seconds{quantile="0.99"}',
     'rdp_slo_objective_seconds{objective="e2e"}',
     'rdp_slo_error_budget_burn{objective="e2e"}',
+    # every streamed frame observes its confidence margin
+    "rdp_model_confidence_margin_count",
 )
 
 
@@ -130,9 +147,27 @@ def main() -> int:
             max_frames=4,
         )
         text = scrape(servicer.metrics_server.port)
+        # /debug/drift must serve parseable JSON listing every configured
+        # drift signal (the monitor is still self-baselining after 4
+        # frames; tools/drift_smoke.py exercises the full scoring path)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{servicer.metrics_server.port}/debug/drift",
+            timeout=30,
+        ) as resp:
+            drift_payload = json.loads(resp.read().decode())
     finally:
         server.stop(grace=None)
         servicer.close()
+
+    if not drift_payload.get("enabled"):
+        print(f"FAIL: /debug/drift reports disabled: {drift_payload}")
+        return 1
+    missing_signals = [s for s in DRIFT_SIGNALS
+                       if s not in drift_payload.get("signals", {})]
+    if missing_signals:
+        print(f"FAIL: /debug/drift is missing signals {missing_signals}")
+        print(json.dumps(drift_payload, indent=1)[:2000])
+        return 1
 
     missing = [f for f in REQUIRED_FAMILIES if f"# TYPE {f} " not in text]
     missing += [s for s in REQUIRED_SAMPLES if s not in text]
