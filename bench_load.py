@@ -253,6 +253,289 @@ def run_level(stub, request, arrivals: list[float], workers: int,
     return lat_ms, errors, wall
 
 
+# -- fleet mode --------------------------------------------------------------
+
+
+def _one_shot(stub, request, deadline_s=None) -> bool:
+    """One single-frame stream; True when it completed OK."""
+    try:
+        status = None
+        for resp in stub.AnalyzeActuatorPerformance(iter([request]),
+                                                    timeout=deadline_s):
+            status = resp.status
+        return status is not None and not status.startswith("ERROR")
+    except Exception:
+        return False
+
+
+def _warm_fleet(stub, request, fe, endpoints, tries: int = 40) -> int:
+    """Warm EVERY live replica through the front-end (each pays its own
+    XLA compile on its first frame): fire concurrent single-frame streams
+    until each placeable replica has served at least one, counting (not
+    failing on) errors -- an armed one-shot RDP_FAULTS on one replica is
+    absorbed here, exactly like the single-server warm phase."""
+    errors = 0
+    want = set(endpoints)
+    for _ in range(tries):
+        served = {r.endpoint for r in fe.router.replicas
+                  if r.endpoint in want and r.frames > 0}
+        live = {r.endpoint for r in fe.router.replicas
+                if r.endpoint in want and r.placeable}
+        if live and live <= served:
+            break
+        with ThreadPoolExecutor(max_workers=2 * len(want)) as pool:
+            results = list(pool.map(
+                lambda _: _one_shot(stub, request),
+                range(2 * len(want)),
+            ))
+        errors += sum(1 for ok in results if not ok)
+    return errors
+
+
+def run_fleet_mode(cli, slo_ms: float, deadline_s: float | None,
+                   load_spec, duration: float, frame_wh) -> None:
+    """The ``--fleet N`` legs: N replica subprocesses (each a full
+    serving/server.py process on faked CPU devices, sharing one tiny
+    registry) behind the in-process fleet front-end.
+
+    Three legs, identical Poisson arrivals (same seed) so goodput is
+    comparable: ``1-replica`` (front-end over one replica -- the
+    scaling/parity anchor), ``N-replica`` (the whole fleet), and
+    ``replica-kill`` (one replica SIGKILLed mid-level: every accepted
+    frame must still terminate, the victim must drop out of placement
+    via grpc.health.v1, and -- once respawned on its old port -- rejoin
+    through the half-open probe). Rows land in LOADBENCH.json tagged
+    ``fleet_leg`` under the usual one-JSON-line contract."""
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        force_cpu_platform,
+    )
+
+    force_cpu_platform(min_devices=1)
+
+    import grpc
+
+    from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+    from robotic_discovery_platform_tpu.serving import (
+        client as client_lib,
+        frontend as frontend_lib,
+        replica as replica_lib,
+    )
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+    from robotic_discovery_platform_tpu.utils.config import ServerConfig
+
+    n = cli.fleet
+    w, h = frame_wh
+    loads = [v for v, mult in load_spec if not mult] or [10.0]
+    if len(loads) != len(load_spec):
+        raise ValueError("--fleet legs need absolute loads (no 'Nx' "
+                         "capacity multiples)")
+
+    tmp = Path(tempfile.mkdtemp(prefix="rdp-fleet-bench-"))
+    uri = replica_lib.register_tiny_model(tmp / "mlruns", img_size=w)
+    per_env = {}
+    if cli.fleet_fault:
+        # arm the fault on replica 0 ONLY: the point of the fleet fault
+        # leg is one degraded member inside a healthy fleet
+        per_env[0] = {"RDP_FAULTS": cli.fleet_fault}
+    replicas = replica_lib.spawn_local_replicas(
+        n, uri, img_size=w, slo_ms=slo_ms, per_replica_env=per_env,
+    )
+    endpoints = [r.endpoint for r in replicas]
+    replica_lib.wait_serving(endpoints)
+
+    source = SyntheticSource(width=w, height=h, seed=cli.seed, n_frames=1)
+    source.start()
+    color, depth = source.get_frames()
+    source.stop()
+    request = client_lib.encode_request(color, depth)
+
+    legs = [("1-replica", endpoints[:1], False),
+            (f"{n}-replica", endpoints, False),
+            ("replica-kill", endpoints, True)]
+    rows: list[dict] = []
+    leg_summaries: dict[str, dict] = {}
+    warm_errors = 0
+    kill_report: dict = {}
+    try:
+        for leg_name, eps, kill in legs:
+            fcfg = ServerConfig(
+                address="localhost:0",
+                fleet_replicas=",".join(eps),
+                fleet_poll_s=0.15,
+                fleet_probe_timeout_s=1.0,
+                fleet_breaker_failures=1,
+                fleet_breaker_reset_s=1.0,
+            )
+            f_server, fe = frontend_lib.build_frontend(fcfg)
+            fport = f_server.add_insecure_port("localhost:0")
+            f_server.start()
+            channel = grpc.insecure_channel(f"localhost:{fport}")
+            stub = vision_grpc.VisionAnalysisServiceStub(channel)
+            try:
+                if not fe.router.wait_live(len(eps), timeout_s=60):
+                    raise RuntimeError(
+                        f"leg {leg_name}: only {fe.router.live_count} of "
+                        f"{len(eps)} replicas became placeable")
+                warm_errors += _warm_fleet(stub, request, fe, eps)
+                # identical arrival schedule per leg: fresh rng, same seed
+                rng = np.random.default_rng(cli.seed)
+                leg_rows = []
+                pinned: dict[str, int] = {"sent": 0, "responses": 0,
+                                          "errors": 0,
+                                          "stream_failures": 0}
+                pinned_lock = threading.Lock()
+
+                def pinned_stream():
+                    """One long-lived stream held OPEN across the kill:
+                    with one of these per replica (ring walk spreads
+                    them), the victim always has a live stream whose
+                    frames must fail over -- deterministic failover
+                    evidence at any offered load."""
+                    def gen():
+                        end = time.monotonic() + duration + 1.0
+                        while time.monotonic() < end:
+                            with pinned_lock:
+                                pinned["sent"] += 1
+                            yield request
+                            time.sleep(0.15)
+
+                    try:
+                        for resp in stub.AnalyzeActuatorPerformance(
+                                gen(), timeout=duration + 30):
+                            with pinned_lock:
+                                pinned["responses"] += 1
+                                if resp.status.startswith("ERROR"):
+                                    pinned["errors"] += 1
+                    except Exception:
+                        with pinned_lock:
+                            pinned["stream_failures"] += 1
+
+                for rate in loads:
+                    arrivals = poisson_arrivals(rate, duration, rng)
+                    if not arrivals:
+                        continue
+                    dropout_seen = threading.Event()
+                    victim = replicas[-1]
+                    pinned_threads: list[threading.Thread] = []
+                    if kill:
+                        for _ in eps:
+                            t = threading.Thread(target=pinned_stream,
+                                                 daemon=True)
+                            t.start()
+                            pinned_threads.append(t)
+                        time.sleep(0.3)  # both streams placed pre-kill
+
+                        def do_kill(victim=victim, fe=fe):
+                            victim.kill()
+                            deadline = time.monotonic() + 5.0
+                            while time.monotonic() < deadline:
+                                if fe.router.live_count < len(eps):
+                                    dropout_seen.set()
+                                    return
+                                time.sleep(0.05)
+
+                        killer = threading.Timer(0.45 * duration, do_kill)
+                        killer.daemon = True
+                        killer.start()
+                    lat_ms, errors, wall = run_level(
+                        stub, request, arrivals, cli.workers, deadline_s)
+                    row = summarize_level(lat_ms, errors, rate, wall,
+                                          slo_ms)
+                    row["fleet_leg"] = leg_name
+                    row["replicas"] = len(eps)
+                    leg_rows.append(row)
+                    print(f"# fleet leg={leg_name} offered={rate:.1f}rps "
+                          f"n={len(lat_ms)} errors={errors} "
+                          f"p99={row['p99_ms']}", file=sys.stderr)
+                    if kill:
+                        killer.join(timeout=duration)
+                        for t in pinned_threads:
+                            t.join(timeout=duration + 60)
+                rows.extend(leg_rows)
+                top = leg_rows[-1] if leg_rows else {}
+                leg_summaries[leg_name] = {
+                    "offered_rps": top.get("offered_rps"),
+                    "arrivals": top.get("arrivals"),
+                    "n": top.get("n"),
+                    "errors": top.get("errors"),
+                    "goodput_rps": top.get("goodput_rps"),
+                    "p99_ms": top.get("p99_ms"),
+                    "violation_rate": top.get("violation_rate"),
+                    "balance": [r.frames for r in fe.router.replicas],
+                }
+                if kill:
+                    kill_report = {
+                        "dropped_out": dropout_seen.is_set(),
+                        "pinned": dict(pinned),
+                        "failovers": fe.router.failovers_total,
+                        "failover_frames_rerouted":
+                            fe.router.failover_frames_rerouted,
+                        "failover_frames_error_completed":
+                            fe.router.failover_frames_error_completed,
+                        "rejoined": False,
+                    }
+                    # respawn the victim on its old port: the static
+                    # endpoint list has not changed, so health-gated
+                    # rejoin through the half-open probe is the whole
+                    # recovery story
+                    fresh = replica_lib.respawn_replica(replicas[-1])
+                    replicas[-1] = fresh
+                    replica_lib.wait_serving([fresh.endpoint])
+                    kill_report["rejoined"] = fe.router.wait_live(
+                        len(eps), timeout_s=30)
+            finally:
+                channel.close()
+                f_server.stop(grace=None)
+                fe.close()
+    finally:
+        replica_lib.stop_replicas(replicas)
+
+    one = leg_summaries.get("1-replica", {})
+    full = leg_summaries.get(f"{n}-replica", {})
+    fleet_block = {
+        "replicas": n,
+        "legs": leg_summaries,
+        "kill": kill_report,
+        "scaling_vs_1": (round(full["goodput_rps"] / one["goodput_rps"],
+                               3)
+                         if one.get("goodput_rps") else None),
+        "fault": cli.fleet_fault or None,
+    }
+
+    payload = {
+        "metric": "open_loop_tail_latency",
+        "backend": "cpu",
+        "unit": "ms",
+        "arrivals": "poisson",
+        "smoke": True,
+        "slo_ms": slo_ms,
+        "deadline_ms": (deadline_s * 1e3 if deadline_s else 0.0),
+        "workers": cli.workers,
+        "frame": [w, h],
+        "fleet": fleet_block,
+        "rows": rows,
+    }
+    Path(cli.out).write_text(json.dumps(payload, indent=2) + "\n")
+
+    top = rows[-1] if rows else {}
+    p99 = top.get("p99_ms")
+    _emit_result({
+        "metric": "open_loop_tail_latency",
+        "backend": "cpu",
+        "value": p99 if p99 is not None and math.isfinite(p99) else 0.0,
+        "unit": "ms",
+        "offered_rps": top.get("offered_rps", 0.0),
+        "goodput_rps": top.get("goodput_rps", 0.0),
+        "violation_rate": top.get("violation_rate", 0.0),
+        "errors": warm_errors + sum(r["errors"] for r in rows),
+        "warm_errors": warm_errors,
+        "levels": len(rows),
+        "fleet": fleet_block,
+        "out": cli.out,
+        "smoke": True,
+    })
+
+
 # -- smoke server ------------------------------------------------------------
 
 
@@ -364,6 +647,15 @@ def main() -> None:
                         help="smoke-server mesh width (faked CPU devices); "
                              ">1 exercises multi-chip routing and the "
                              "serving.chip.<i>.dispatch quarantine path")
+    parser.add_argument("--fleet", type=int, default=0, metavar="N",
+                        help="boot N replica server subprocesses behind "
+                             "the in-process fleet front-end and run the "
+                             "1-replica / N-replica / replica-kill legs "
+                             "(serving/frontend.py); needs --smoke")
+    parser.add_argument("--fleet-fault", default=None, metavar="SPEC",
+                        help="RDP_FAULTS spec armed on replica 0 ONLY "
+                             "(one degraded member inside a healthy "
+                             "fleet), e.g. serving.batch.complete:exc:1")
     parser.add_argument("--deadline-ms", type=float, default=None,
                         help="per-request gRPC deadline (default: the "
                              "SLO itself -- a client with a 250ms "
@@ -398,6 +690,16 @@ def main() -> None:
                      "needs --smoke")
     if cli.chips > 1 and not cli.smoke:
         parser.error("--chips shapes the smoke server; it needs --smoke")
+    if cli.fleet:
+        if not cli.smoke:
+            parser.error("--fleet boots local CPU replicas; it needs "
+                         "--smoke")
+        if cli.fleet < 2:
+            parser.error("--fleet needs at least 2 replicas (the legs "
+                         "compare N vs 1 and kill one mid-run)")
+        if cli.controller != "off":
+            parser.error("--controller tunes the single-server legs; "
+                         "fleet replicas run their own control plane")
     legs = ["off", "on"] if cli.controller == "both" else [cli.controller]
 
     import grpc
@@ -423,6 +725,11 @@ def main() -> None:
     deadline_ms = (cli.deadline_ms if cli.deadline_ms is not None
                    else slo_ms)
     deadline_s = deadline_ms / 1e3 if deadline_ms > 0 else None
+
+    if cli.fleet:
+        run_fleet_mode(cli, slo_ms, deadline_s, load_spec, duration,
+                       (w, h))
+        return
 
     rng = np.random.default_rng(cli.seed)
     request = None

@@ -251,6 +251,68 @@ CHIP_FAILOVER_FRAMES = REGISTRY.counter(
     "a quarantining chip (each bounded to chips+1 attempts).",
 )
 
+# -- serving fleet (serving/fleet.py + serving/frontend.py) ------------------
+
+FLEET_REPLICAS_LIVE = REGISTRY.gauge(
+    "rdp_fleet_replicas_live",
+    "Replica servers currently placeable by the fleet front-end (health "
+    "SERVING and replica breaker closed).",
+)
+FLEET_REPLICAS_QUARANTINED = REGISTRY.gauge(
+    "rdp_fleet_replicas_quarantined",
+    "Replicas held out of the placement ring by an open/half-open "
+    "per-replica circuit breaker while their health endpoint still "
+    "answers (stream-level failures quarantine faster than the health "
+    "poll notices).",
+)
+FLEET_REPLICA_STREAMS = REGISTRY.gauge(
+    "rdp_fleet_replica_streams",
+    "Client streams the front-end currently has placed on each replica "
+    "(the least-loaded pick's signal).",
+    ("replica",),
+)
+FLEET_REPLICA_FRAMES = REGISTRY.counter(
+    "rdp_fleet_replica_frames_total",
+    "Frames relayed through each replica by the fleet front-end.",
+    ("replica",),
+)
+FLEET_REPLICA_BURN = REGISTRY.gauge(
+    "rdp_fleet_replica_burn",
+    "Each replica's rdp_slo_error_budget_burn as last scraped over the "
+    "replica stats RPC -- the fleet controller's rebalance signal.",
+    ("replica",),
+)
+FLEET_REPLICA_WEIGHT = REGISTRY.gauge(
+    "rdp_fleet_replica_weight",
+    "Fleet-controller placement weight per replica (1.0 = full share; "
+    "burning replicas decay toward ServerConfig.fleet_weight_floor).",
+    ("replica",),
+)
+FLEET_PLACEMENTS = REGISTRY.counter(
+    "rdp_fleet_placements_total",
+    "New-stream placement decisions, by chosen replica.",
+    ("replica",),
+)
+FLEET_FAILOVERS = REGISTRY.counter(
+    "rdp_fleet_failovers_total",
+    "Stream-level replica failures the front-end handled (the stream was "
+    "re-routed to another replica or its in-flight frames were "
+    "error-completed).",
+)
+FLEET_FAILOVER_FRAMES = REGISTRY.counter(
+    "rdp_fleet_failover_frames_total",
+    "In-flight frames on a dead replica, by outcome: 'rerouted' (re-sent "
+    "to a healthy replica under the caller's deadline) or "
+    "'error_completed' (answered with an ERROR status -- never silently "
+    "dropped).",
+    ("outcome",),
+)
+FLEET_CONTROLLER_ACTIONS = REGISTRY.counter(
+    "rdp_fleet_controller_actions_total",
+    "Fleet controller weight rebalances, by action (deweight, reweight).",
+    ("action",),
+)
+
 # -- resilience --------------------------------------------------------------
 
 #: closed=0 / open=1 / half_open=2 (alert on `rdp_breaker_state == 1`).

@@ -1,0 +1,523 @@
+"""Cross-host serving fleet: membership, placement, and fleet-level SLO
+control over per-host replica servers.
+
+The multi-chip router (serving/batching.DeviceRouter) saturates ONE
+process's devices; this module is the next ring out -- the Pathways DCN
+direction (PAPERS.md): a front-end (serving/frontend.py) fans
+``AnalyzeActuatorPerformance`` streams over N per-host replicas, each a
+full serving/server.py process with its own chip mesh, reached over
+localhost/DCN gRPC. The design deliberately mirrors the chip ring one
+level up:
+
+- **Membership is health-gated** on the replicas' existing
+  ``grpc.health.v1`` surface: replicas come from a static endpoint list
+  (``ServerConfig.fleet_replicas`` / ``RDP_FLEET_REPLICAS``) and are
+  polled every ``fleet_poll_s``; a replica whose status flips
+  NOT_SERVING (drain, crash, all chips quarantined) drops out of the
+  placement ring exactly like a chip drops out of the chip ring, and
+  rejoins on recovery through a half-open probe (the per-replica
+  :class:`~robotic_discovery_platform_tpu.resilience.CircuitBreaker`
+  admits one health probe after ``fleet_breaker_reset_s``; success
+  reinstates).
+- **Placement is least-loaded with ring tie-break**, fed by each
+  replica's reported inflight/burn: a lightweight stats RPC
+  (:func:`add_replica_stats_to_server`, a JSON-over-gRPC unary the
+  replica server registers next to health) carries the replica's
+  in-flight streams and its ``rdp_slo_error_budget_burn`` reading, so
+  the front-end never needs to scrape HTTP /metrics to place a stream.
+- **The PR 7 control loop is lifted one level**: a
+  :class:`FleetController` consumes the per-replica burn gauges and
+  rebalances new-stream placement (a weighted ring -- burning replicas
+  are de-weighted toward ``fleet_weight_floor``) BEFORE any replica
+  browns out; the replica's own reactive controller still handles its
+  intra-host knobs.
+
+Clockwork (Gujarati et al., OSDI 2020) is the other parent: replicas are
+exclusively owned by this front-end's placement decisions, and
+least-loaded pick with ring tie-break is the work-conserving
+simplification of its central scheduler for homogeneous single-model
+replicas.
+
+This module is deliberately jax-free: a fleet front-end routes bytes, it
+never touches an accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable
+
+import grpc
+
+from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.resilience import CircuitBreaker
+from robotic_discovery_platform_tpu.resilience.breaker import CLOSED
+from robotic_discovery_platform_tpu.serving import health as health_lib
+from robotic_discovery_platform_tpu.serving.proto import (
+    health_pb2,
+    vision_grpc,
+)
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def resolve_fleet_replicas(configured: str) -> list[str]:
+    """The replica endpoint list serving should fan out to: the
+    ``RDP_FLEET_REPLICAS`` env var when set, else the configured value
+    (``ServerConfig.fleet_replicas``), split on commas with blanks
+    dropped. Empty list = no fleet (plain single-host serving)."""
+    env = os.environ.get("RDP_FLEET_REPLICAS", "").strip()
+    spec = env if env else configured
+    return [e.strip() for e in spec.split(",") if e.strip()]
+
+
+# -- replica stats RPC -------------------------------------------------------
+#
+# A lightweight unary the replica server registers next to grpc.health.v1:
+# request is empty bytes, response is a UTF-8 JSON object (inflight
+# streams, frames served, error-budget burn, chips/quarantined, version,
+# draining). Hand-built on grpcio's generic APIs like vision_grpc.py /
+# health.py -- no protoc plugin in the image, and a JSON payload keeps the
+# schema evolvable without wire churn.
+
+STATS_SERVICE = "rdp.fleet.ReplicaStats"
+_STATS_PATH = f"/{STATS_SERVICE}/Get"
+
+
+def _identity_bytes(b):
+    return bytes(b or b"")
+
+
+class ReplicaStatsStub:
+    """Client stub: ``stub.Get(b"")`` returns the stats JSON bytes."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.Get = channel.unary_unary(
+            _STATS_PATH,
+            request_serializer=_identity_bytes,
+            response_deserializer=_identity_bytes,
+        )
+
+
+def add_replica_stats_to_server(
+        server, provider: Callable[[], dict]) -> None:
+    """Register the stats RPC; ``provider`` returns the stats dict (the
+    serving layer passes ``VisionAnalysisService.replica_stats``)."""
+
+    def get(request, context):
+        return json.dumps(provider()).encode("utf-8")
+
+    handlers = {
+        "Get": grpc.unary_unary_rpc_method_handler(
+            get,
+            request_deserializer=_identity_bytes,
+            response_serializer=_identity_bytes,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(STATS_SERVICE, handlers),)
+    )
+
+
+def fetch_replica_stats(stub: ReplicaStatsStub,
+                        timeout_s: float | None = None) -> dict:
+    payload = stub.Get(b"", timeout=timeout_s)
+    stats = json.loads(payload.decode("utf-8") or "{}")
+    if not isinstance(stats, dict):
+        raise ValueError(f"replica stats payload is {type(stats).__name__},"
+                         " not an object")
+    return stats
+
+
+# -- placement ---------------------------------------------------------------
+
+
+def _least_loaded(loads, start: int = 0) -> int:
+    """Index of the minimum of ``loads``, ties broken in ring order from
+    ``start`` -- parallel/mesh.least_loaded re-stated here so the
+    front-end never imports jax just to walk a ring."""
+    n = len(loads)
+    best = start % n
+    for off in range(1, n):
+        i = (start + off) % n
+        if loads[i] < loads[best]:
+            best = i
+    return best
+
+
+class Replica:
+    """One fleet member: endpoint, lazy gRPC plumbing, and the live state
+    placement reads (health verdict, breaker, inflight, burn, weight).
+
+    The channel/stubs are created on first use so placement units can
+    drive a router over fake replicas without any sockets."""
+
+    def __init__(self, endpoint: str, *, breaker_failures: int = 2,
+                 breaker_reset_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 channel_factory=grpc.insecure_channel):
+        self.endpoint = endpoint
+        self.breaker = CircuitBreaker(
+            failure_threshold=max(1, breaker_failures),
+            reset_timeout_s=breaker_reset_s,
+            name=f"replica:{endpoint}",
+            clock=clock,
+        )
+        self._channel_factory = channel_factory
+        self._channel: grpc.Channel | None = None
+        self._stub = None
+        self._health_stub = None
+        self._stats_stub = None
+        #: last health-poll verdict (SERVING and reachable)
+        self.serving = False
+        #: front-end-placed streams currently open on this replica
+        self.inflight = 0
+        #: frames relayed through this replica (front-end count)
+        self.frames = 0
+        #: streams ever placed here
+        self.placements = 0
+        #: last scraped rdp_slo_error_budget_burn (0.0 when unknown)
+        self.burn = 0.0
+        #: FleetController placement weight (1.0 = full share)
+        self.weight = 1.0
+        #: last full stats payload (diagnostics)
+        self.stats: dict = {}
+
+    # -- wiring (lazy) ------------------------------------------------------
+
+    @property
+    def channel(self) -> grpc.Channel:
+        if self._channel is None:
+            self._channel = self._channel_factory(self.endpoint)
+        return self._channel
+
+    @property
+    def stub(self) -> vision_grpc.VisionAnalysisServiceStub:
+        if self._stub is None:
+            self._stub = vision_grpc.VisionAnalysisServiceStub(self.channel)
+        return self._stub
+
+    @property
+    def health_stub(self) -> health_lib.HealthStub:
+        if self._health_stub is None:
+            self._health_stub = health_lib.HealthStub(self.channel)
+        return self._health_stub
+
+    @property
+    def stats_stub(self) -> ReplicaStatsStub:
+        if self._stats_stub is None:
+            self._stats_stub = ReplicaStatsStub(self.channel)
+        return self._stats_stub
+
+    def close(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+            self._stub = self._health_stub = self._stats_stub = None
+
+    # -- placement state ----------------------------------------------------
+
+    @property
+    def placeable(self) -> bool:
+        """In the ring: last health probe said SERVING and the breaker is
+        closed (an open breaker = quarantined until its half-open probe
+        succeeds)."""
+        return self.serving and self.breaker.state == CLOSED
+
+    @property
+    def effective_load(self) -> float:
+        """What least-loaded pick compares: in-flight streams scaled by
+        the controller's weight (a de-weighted replica looks busier than
+        its raw count, shifting new streams away)."""
+        return self.inflight / max(self.weight, 1e-6)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Replica({self.endpoint!r}, serving={self.serving}, "
+                f"inflight={self.inflight}, burn={self.burn:.2f}, "
+                f"weight={self.weight:.2f})")
+
+
+class FleetController:
+    """The PR 7 reactive control loop lifted to fleet level: consume each
+    replica's error-budget burn and rebalance NEW-stream placement (the
+    weighted ring) before any replica browns out.
+
+    Pure function of the scraped burn values -- no thread of its own; the
+    router's poll loop calls :meth:`rebalance` after every stats refresh,
+    and tests call it directly with injected replicas. A replica's weight
+    is 1.0 while its burn stays at or under ``burn_high`` and decays as
+    ``burn_high / burn`` above it, floored at ``weight_floor`` so a
+    burning replica keeps serving enough traffic to report recovery (the
+    same starve-the-signal reasoning as brownout rung 3's duty cycle)."""
+
+    #: weight moves smaller than this are ignored (gauge/log hygiene)
+    DEADBAND = 0.05
+
+    def __init__(self, *, burn_high: float = 0.8,
+                 weight_floor: float = 0.1):
+        if not 0.0 < weight_floor <= 1.0:
+            raise ValueError("weight_floor must be in (0, 1]")
+        self.burn_high = burn_high
+        self.weight_floor = weight_floor
+        self.actions_total = 0
+
+    def target_weight(self, burn: float) -> float:
+        if burn <= self.burn_high:
+            return 1.0
+        return max(self.weight_floor, self.burn_high / burn)
+
+    def rebalance(self, replicas: list[Replica]) -> None:
+        for r in replicas:
+            target = self.target_weight(r.burn)
+            if abs(target - r.weight) <= self.DEADBAND and target != 1.0:
+                continue
+            if target != r.weight:
+                action = ("deweight" if target < r.weight else "reweight")
+                if abs(target - r.weight) > self.DEADBAND:
+                    self.actions_total += 1
+                    obs.FLEET_CONTROLLER_ACTIONS.labels(action=action).inc()
+                    log.info(
+                        "fleet controller: %s %s weight %.2f -> %.2f "
+                        "(burn %.2f)", action, r.endpoint, r.weight,
+                        target, r.burn,
+                    )
+                r.weight = target
+            obs.FLEET_REPLICA_WEIGHT.labels(replica=r.endpoint).set(
+                r.weight)
+
+
+class FleetRouter:
+    """Health-gated membership + least-loaded stream placement over the
+    static replica list.
+
+    One poll thread drives the whole control surface: per-replica health
+    probe (the breaker's half-open probe when quarantined), stats scrape
+    (inflight/burn), controller rebalance, membership metrics, and the
+    ``on_membership(live_count)`` callback the front-end uses to flip its
+    own readiness. ``poll_once`` is public so tests drive membership
+    deterministically without the thread."""
+
+    def __init__(self, endpoints: list[str], *, poll_s: float = 1.0,
+                 probe_timeout_s: float = 1.0, breaker_failures: int = 2,
+                 breaker_reset_s: float = 5.0,
+                 controller: FleetController | None = None,
+                 on_membership: Callable[[int], None] | None = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 channel_factory=grpc.insecure_channel):
+        if not endpoints:
+            raise ValueError("a fleet needs at least one replica endpoint")
+        self.replicas = [
+            Replica(ep, breaker_failures=breaker_failures,
+                    breaker_reset_s=breaker_reset_s, clock=clock,
+                    channel_factory=channel_factory)
+            for ep in endpoints
+        ]
+        self.poll_s = poll_s
+        self.probe_timeout_s = probe_timeout_s
+        self.controller = controller
+        self.on_membership = on_membership
+        self._lock = threading.Lock()
+        self._ring_start = 0
+        self._last_live = -1
+        self._stop: threading.Event | None = None
+        self._thread: threading.Thread | None = None
+        #: stream-level failovers observed (reroutes + error-completions)
+        self.failovers_total = 0
+        self.failover_frames_rerouted = 0
+        self.failover_frames_error_completed = 0
+
+    # -- membership ----------------------------------------------------------
+
+    def poll_once(self) -> int:
+        """One membership tick; returns the live (placeable) count."""
+        for r in self.replicas:
+            healthy = False
+            exc: BaseException | None = None
+            try:
+                resp = r.health_stub.Check(
+                    health_pb2.HealthCheckRequest(service=""),
+                    timeout=self.probe_timeout_s,
+                )
+                healthy = resp.status == health_lib.SERVING
+                if not healthy:
+                    exc = RuntimeError(
+                        f"health status {resp.status} (not SERVING)")
+            except Exception as e:  # noqa: BLE001 - any probe failure
+                exc = e
+            was = r.placeable
+            if healthy:
+                r.serving = True
+                # a healthy probe is the half-open "probe stream": only a
+                # breaker that ADMITS one may close on it, so a crashy
+                # replica must hold healthy through its reset timeout
+                # before rejoining the ring
+                if r.breaker.state == CLOSED or r.breaker.allow():
+                    r.breaker.record_success()
+            else:
+                r.serving = False
+                r.breaker.record_failure(exc)
+            if r.placeable != was:
+                log.warning(
+                    "fleet membership: replica %s %s (%s)",
+                    r.endpoint,
+                    "joined" if r.placeable else "dropped out",
+                    "healthy" if healthy else exc,
+                )
+            if r.serving:
+                self._scrape_stats(r)
+            else:
+                obs.FLEET_REPLICA_BURN.labels(replica=r.endpoint).set(0.0)
+        if self.controller is not None:
+            self.controller.rebalance(self.replicas)
+        return self._publish_membership()
+
+    def _scrape_stats(self, r: Replica) -> None:
+        """Advisory: a failed scrape never drops a healthy replica --
+        placement just keeps using the front-end's own inflight count and
+        the last known burn."""
+        try:
+            stats = fetch_replica_stats(r.stats_stub, self.probe_timeout_s)
+        except Exception as exc:  # noqa: BLE001
+            log.debug("stats scrape of %s failed: %s", r.endpoint, exc)
+            return
+        r.stats = stats
+        try:
+            r.burn = float(stats.get("burn", 0.0))
+        except (TypeError, ValueError):
+            r.burn = 0.0
+        obs.FLEET_REPLICA_BURN.labels(replica=r.endpoint).set(r.burn)
+
+    def _publish_membership(self) -> int:
+        live = self.live_count
+        obs.FLEET_REPLICAS_LIVE.set(live)
+        obs.FLEET_REPLICAS_QUARANTINED.set(self.quarantined_count)
+        if live != self._last_live:
+            self._last_live = live
+            if self.on_membership is not None:
+                try:
+                    self.on_membership(live)
+                except Exception:  # pragma: no cover - observer bug
+                    log.exception("fleet membership callback failed")
+        return live
+
+    @property
+    def live_count(self) -> int:
+        return sum(1 for r in self.replicas if r.placeable)
+
+    @property
+    def quarantined_count(self) -> int:
+        """Replicas held out of the ring by an OPEN breaker (half-open
+        counts as quarantined too: it is not placeable until its probe
+        succeeds)."""
+        return sum(
+            1 for r in self.replicas
+            if r.serving and r.breaker.state != CLOSED
+        )
+
+    def wait_live(self, min_live: int = 1,
+                  timeout_s: float = 30.0) -> bool:
+        """Block until at least ``min_live`` replicas are placeable (the
+        poll thread must be running) or the timeout expires."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.live_count >= min_live:
+                return True
+            time.sleep(min(0.05, self.poll_s))
+        return self.live_count >= min_live
+
+    # -- placement -----------------------------------------------------------
+
+    def pick(self, exclude: Replica | None = None) -> Replica | None:
+        """Place one new stream: the least effectively-loaded placeable
+        replica, ties walking the ring (idle fleets round-robin, skewed
+        fleets drain toward the emptiest host). Increments the chosen
+        replica's inflight; callers MUST :meth:`release` it."""
+        with self._lock:
+            loads = [
+                r.effective_load
+                if (r.placeable and r is not exclude) else float("inf")
+                for r in self.replicas
+            ]
+            if not any(load != float("inf") for load in loads):
+                return None
+            idx = _least_loaded(loads, self._ring_start)
+            self._ring_start = (idx + 1) % len(self.replicas)
+            r = self.replicas[idx]
+            r.inflight += 1
+            r.placements += 1
+        obs.FLEET_PLACEMENTS.labels(replica=r.endpoint).inc()
+        obs.FLEET_REPLICA_STREAMS.labels(replica=r.endpoint).set(r.inflight)
+        return r
+
+    def release(self, replica: Replica) -> None:
+        with self._lock:
+            replica.inflight = max(0, replica.inflight - 1)
+        obs.FLEET_REPLICA_STREAMS.labels(replica=replica.endpoint).set(
+            replica.inflight)
+
+    def on_stream_ok(self, replica: Replica) -> None:
+        """A relayed stream completed cleanly: clears the breaker's
+        consecutive-failure count (stream success is as good as a health
+        probe)."""
+        if replica.breaker.state == CLOSED:
+            replica.breaker.record_success()
+
+    def on_stream_error(self, replica: Replica,
+                        exc: BaseException | None = None) -> None:
+        """A relayed stream died at the transport level: count it toward
+        the replica's breaker (an open breaker quarantines the replica
+        out of the ring without waiting for the next health poll)."""
+        replica.breaker.record_failure(exc)
+        self._publish_membership()
+
+    def record_failover(self, *, rerouted: int = 0,
+                        error_completed: int = 0) -> None:
+        with self._lock:
+            self.failovers_total += 1
+            self.failover_frames_rerouted += rerouted
+            self.failover_frames_error_completed += error_completed
+        obs.FLEET_FAILOVERS.inc()
+        if rerouted:
+            obs.FLEET_FAILOVER_FRAMES.labels(outcome="rerouted").inc(
+                rerouted)
+        if error_completed:
+            obs.FLEET_FAILOVER_FRAMES.labels(
+                outcome="error_completed").inc(error_completed)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(self.poll_s):
+                try:
+                    self.poll_once()
+                except Exception:  # pragma: no cover - keep polling
+                    log.exception("fleet membership poll failed")
+
+        # one immediate tick so the front-end does not report an empty
+        # fleet for a full poll period after boot
+        try:
+            self.poll_once()
+        except Exception:  # pragma: no cover
+            log.exception("initial fleet membership poll failed")
+        self._thread = threading.Thread(
+            target=loop, name="fleet-membership", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for r in self.replicas:
+            r.close()

@@ -1,0 +1,379 @@
+"""Fleet front-end: the gRPC service clients actually dial.
+
+Accepts the existing ``AnalyzeActuatorPerformance`` bidirectional stream
+UNCHANGED (same method path, same message bytes -- a client cannot tell a
+front-end from a single server) and fans each stream out to one of the
+per-host replica servers the :class:`~robotic_discovery_platform_tpu.
+serving.fleet.FleetRouter` considers placeable, relaying requests and
+responses 1:1 in order.
+
+Failover contract (the part a plain proxy gets wrong): every frame the
+front-end has ACCEPTED from the client is either answered by a replica or
+error-completed -- never silently dropped.
+
+- Requests are pumped off the client stream into a bounded inbox; a frame
+  is appended to the stream's ``pending`` deque BEFORE it is sent to the
+  replica, and popped only when its (in-order) response arrives.
+- When the replica stream dies at the transport level (replica killed,
+  drained, connection refused), the failure counts toward that replica's
+  breaker (quarantining it out of the ring without waiting for the next
+  health poll) and the pending frames fail over: if the caller's deadline
+  still has budget, another placeable replica exists, and the per-stream
+  failover budget (``fleet_max_failovers``) is not exhausted, the whole
+  pending window is RE-SENT to the new replica and the stream continues
+  there; otherwise each pending frame is error-completed with an
+  ``ERROR: ReplicaUnavailable`` status response (the same
+  keep-the-stream-alive per-frame error contract the replica server
+  itself uses).
+- With one replica and no failure, the relay is a transparent pass-through:
+  the 1-replica fleet path is bitwise-identical to dialing the replica
+  directly (proven in tests/test_fleet.py).
+
+The front-end's own grpc.health.v1 readiness tracks fleet membership:
+SERVING while at least one replica is placeable, NOT_SERVING otherwise --
+so front-ends themselves compose (a load balancer can health-gate them the
+same way they health-gate replicas).
+
+Like fleet.py, this module never imports jax: the front-end routes bytes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from concurrent import futures
+
+import grpc
+
+from robotic_discovery_platform_tpu.observability import (
+    exposition,
+    instruments as obs,
+    trace,
+)
+from robotic_discovery_platform_tpu.serving import (
+    fleet as fleet_lib,
+    health as health_lib,
+)
+from robotic_discovery_platform_tpu.serving.proto import (
+    vision_grpc,
+    vision_pb2,
+)
+from robotic_discovery_platform_tpu.utils.config import ServerConfig
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: client metadata keys forwarded to the replica (gRPC reserves the rest;
+#: traceparent is what makes a frame's client-side failure join the
+#: replica's /debug/spans timeline)
+_FORWARDED_METADATA = (trace.TRACEPARENT,)
+
+#: how often a feeder blocked on an idle client re-checks its generation
+#: (a retired feeder must notice the failover and stand down)
+_FEED_POLL_S = 0.05
+
+
+class _StreamState:
+    """Shared state of one relayed client stream across failover attempts."""
+
+    __slots__ = ("lock", "inbox", "pending", "stash", "client_done",
+                 "closed", "gen", "pump_error")
+
+    def __init__(self, inbox_depth: int = 64):
+        self.lock = threading.Lock()
+        # bounded: a slow replica backpressures the pump thread, and gRPC
+        # flow control pushes that back to the client
+        self.inbox: queue.Queue = queue.Queue(maxsize=inbox_depth)
+        #: sent to the current replica, response not yet relayed
+        self.pending: deque = deque()
+        #: pulled from the inbox by a retired feeder after its attempt
+        #: died; the next attempt's feeder drains this first
+        self.stash: deque = deque()
+        self.client_done = False
+        self.closed = False
+        #: failover generation; a feeder retires when it no longer matches
+        self.gen = 0
+        self.pump_error: BaseException | None = None
+
+
+def _pump(request_iterator, st: _StreamState) -> None:
+    """Client-side pump: the ONE consumer of the client request iterator,
+    so failover attempts never race over it."""
+    try:
+        for req in request_iterator:
+            while True:
+                try:
+                    st.inbox.put(req, timeout=0.1)
+                    break
+                except queue.Full:
+                    if st.closed:
+                        return
+    except Exception as exc:  # noqa: BLE001 - client reset mid-stream
+        st.pump_error = exc
+    finally:
+        st.client_done = True
+
+
+class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
+    """The relay servicer. One instance per front-end process; per-stream
+    state lives on the stack of each handler."""
+
+    def __init__(self, router: fleet_lib.FleetRouter,
+                 cfg: ServerConfig = ServerConfig()):
+        self.router = router
+        self.cfg = cfg
+        self.health = health_lib.HealthServicer()
+        self.health.set(vision_grpc.SERVICE_NAME, health_lib.NOT_SERVING)
+        router.on_membership = self._on_membership
+        self.metrics_server: exposition.MetricsServer | None = None
+        self._closed = False
+
+    # -- membership-driven readiness ----------------------------------------
+
+    def _on_membership(self, live: int) -> None:
+        status = (health_lib.SERVING if live > 0 and not self._closed
+                  else health_lib.NOT_SERVING)
+        self.health.set("", status)
+        self.health.set(vision_grpc.SERVICE_NAME, status)
+
+    # -- the relay -----------------------------------------------------------
+
+    def _feed(self, st: _StreamState, gen: int, resend: list):
+        """Request generator for ONE failover attempt: re-sends the
+        pending window first (already in ``st.pending``), then relays new
+        frames -- each appended to ``pending`` before it is yielded, so a
+        frame gRPC pulled but never delivered is still accounted for."""
+        for req in resend:
+            if st.gen != gen:
+                return
+            yield req
+        while True:
+            if st.gen != gen or st.closed:
+                return
+            req = None
+            with st.lock:
+                if st.stash:
+                    req = st.stash.popleft()
+            if req is None:
+                try:
+                    req = st.inbox.get(timeout=_FEED_POLL_S)
+                except queue.Empty:
+                    if st.client_done and st.inbox.empty():
+                        with st.lock:
+                            if not st.stash:
+                                return
+                    continue
+            if st.gen != gen or st.closed:
+                # pulled after this attempt retired: hand the frame to the
+                # next attempt instead of dropping it
+                with st.lock:
+                    st.stash.append(req)
+                return
+            with st.lock:
+                st.pending.append(req)
+            yield req
+
+    @staticmethod
+    def _forwarded_metadata(context) -> tuple:
+        return tuple(
+            (k, v) for k, v in context.invocation_metadata()
+            if k in _FORWARDED_METADATA
+        )
+
+    @staticmethod
+    def _time_remaining(context) -> float | None:
+        """The caller's remaining deadline budget in seconds, or None for
+        "no deadline". grpc reports deadline-less streams as ~INT64_MAX
+        nanoseconds, which overflows a client-side timeout into an
+        immediately-expired deadline -- normalize anything implausibly
+        large to None."""
+        remaining = context.time_remaining()
+        if remaining is None or remaining > 86400.0 * 365:
+            return None
+        return remaining
+
+    def AnalyzeActuatorPerformance(self, request_iterator, context):
+        router = self.router
+        st = _StreamState()
+        replica = router.pick()
+        if replica is None:
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "no live replica in the serving fleet; retry later",
+            )
+        pump = threading.Thread(
+            target=_pump, args=(request_iterator, st),
+            name="fleet-pump", daemon=True,
+        )
+        pump.start()
+        metadata = self._forwarded_metadata(context)
+        failovers = 0
+        try:
+            while True:
+                st.gen += 1
+                with st.lock:
+                    resend = list(st.pending)
+                try:
+                    call = replica.stub.AnalyzeActuatorPerformance(
+                        self._feed(st, st.gen, resend),
+                        timeout=self._time_remaining(context),
+                        metadata=metadata,
+                    )
+                    for resp in call:
+                        with st.lock:
+                            if st.pending:
+                                st.pending.popleft()
+                        replica.frames += 1
+                        obs.FLEET_REPLICA_FRAMES.labels(
+                            replica=replica.endpoint).inc()
+                        yield resp
+                    # replica closed the stream cleanly (our feeder ended
+                    # after the client finished). A deadline-expired
+                    # replica loop can end with unanswered frames --
+                    # error-complete them rather than dropping silently.
+                    router.on_stream_ok(replica)
+                    yield from self._error_complete(
+                        st, replica, "stream ended with frames unanswered")
+                    return
+                except grpc.RpcError as exc:
+                    if not context.is_active():
+                        return  # client is gone; nothing left to complete
+                    code = (exc.code() if hasattr(exc, "code") else None)
+                    router.on_stream_error(replica, exc)
+                    failovers += 1
+                    with st.lock:
+                        n_pending = len(st.pending)
+                    remaining = self._time_remaining(context)
+                    has_budget = (failovers <= self.cfg.fleet_max_failovers
+                                  and (remaining is None or remaining > 0))
+                    next_replica = (router.pick(exclude=replica)
+                                    if has_budget else None)
+                    if next_replica is not None:
+                        log.warning(
+                            "fleet failover: replica %s died (%s); "
+                            "re-routing %d in-flight frame(s) to %s "
+                            "(failover %d/%d)",
+                            replica.endpoint, code, n_pending,
+                            next_replica.endpoint, failovers,
+                            self.cfg.fleet_max_failovers,
+                        )
+                        router.record_failover(rerouted=n_pending)
+                        router.release(replica)
+                        replica = next_replica
+                        continue
+                    # no replica (or no budget) to re-route to: every
+                    # accepted in-flight frame error-completes, then the
+                    # stream itself fails over to the client
+                    log.warning(
+                        "fleet: replica %s died (%s) with no failover "
+                        "target; error-completing %d in-flight frame(s)",
+                        replica.endpoint, code, n_pending,
+                    )
+                    router.record_failover(error_completed=n_pending)
+                    yield from self._error_complete(
+                        st, replica, f"replica unavailable ({code})")
+                    if (st.client_done and st.inbox.empty()
+                            and not st.stash):
+                        return  # every accepted frame was answered
+                    context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"fleet: replica {replica.endpoint} unavailable "
+                        f"({code}) and no healthy replica to fail over "
+                        "to; in-flight frames were error-completed",
+                    )
+        finally:
+            st.closed = True
+            st.gen += 1  # retire any feeder blocked on an idle client
+            if replica is not None:
+                router.release(replica)
+
+    @staticmethod
+    def _error_complete(st: _StreamState, replica, why: str):
+        """Yield one ERROR-status response per pending frame (in order),
+        clearing the pending window -- the fleet-level analogue of the
+        replica server's keep-the-stream-alive per-frame errors."""
+        with st.lock:
+            stranded = list(st.pending)
+            st.pending.clear()
+        for _ in stranded:
+            yield vision_pb2.AnalysisResponse(
+                status=f"ERROR: ReplicaUnavailable: {replica.endpoint}: "
+                       f"{why}; frame error-completed by fleet front-end",
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+        self.health.set_all(health_lib.NOT_SERVING)
+        self.router.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+
+
+def build_frontend(
+    cfg: ServerConfig = ServerConfig(),
+) -> tuple[grpc.Server, FleetFrontend]:
+    """Wire an unstarted (server, frontend) over ``cfg.fleet_replicas`` /
+    ``RDP_FLEET_REPLICAS``. Mirrors serving/server.build_server: binds
+    ``cfg.address``, registers the vision service + grpc.health.v1, starts
+    the membership poller and the optional /metrics endpoint. Raises when
+    the replica list is empty (a front-end with nothing behind it is a
+    misconfiguration, not a degraded mode)."""
+    endpoints = fleet_lib.resolve_fleet_replicas(cfg.fleet_replicas)
+    if not endpoints:
+        raise ValueError(
+            "fleet front-end needs replica endpoints "
+            "(ServerConfig.fleet_replicas / RDP_FLEET_REPLICAS)"
+        )
+    controller = None
+    if cfg.fleet_controller_enabled:
+        controller = fleet_lib.FleetController(
+            burn_high=cfg.fleet_burn_high,
+            weight_floor=cfg.fleet_weight_floor,
+        )
+    router = fleet_lib.FleetRouter(
+        endpoints,
+        poll_s=cfg.fleet_poll_s,
+        probe_timeout_s=cfg.fleet_probe_timeout_s,
+        breaker_failures=cfg.fleet_breaker_failures,
+        breaker_reset_s=cfg.fleet_breaker_reset_s,
+        controller=controller,
+    )
+    frontend = FleetFrontend(router, cfg)
+    router.start()  # includes one immediate membership tick
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=cfg.max_workers)
+    )
+    vision_grpc.add_VisionAnalysisServiceServicer_to_server(
+        frontend, server)
+    health_lib.add_HealthServicer_to_server(frontend.health, server)
+    server.add_insecure_port(cfg.address)
+    frontend.metrics_server = exposition.maybe_start_metrics_server(
+        cfg.metrics_port
+    )
+    log.info("fleet front-end over %d replica(s): %s",
+             len(endpoints), ", ".join(endpoints))
+    return server, frontend
+
+
+def serve_frontend(cfg: ServerConfig = ServerConfig()) -> None:
+    server, frontend = build_frontend(cfg)
+    server.start()
+    log.info("fleet front-end listening on %s", cfg.address)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        log.info("interrupt: shutting down fleet front-end")
+    finally:
+        server.stop(grace=cfg.drain_grace_s).wait()
+        frontend.close()
+
+
+if __name__ == "__main__":
+    from robotic_discovery_platform_tpu.utils.config import parse_config
+
+    serve_frontend(parse_config().server)

@@ -1,0 +1,357 @@
+"""Per-host replica bootstrap: one full serving/server.py process per
+fleet member, plus the subprocess-cluster helpers that boot a local CPU
+fleet for tests, bench_load ``--fleet``, and the CI fleet-smoke job.
+
+This promotes the pattern tests/multihost_worker.py established for the
+training plane into serving: a worker ``main`` that pins its platform from
+the parent's env, boots the real entry point, and prints exactly ONE JSON
+line the parent parses (here: the bound port), plus parent-side spawn /
+wait-serving / stop helpers. The replica itself is just ``build_server``
+-- same engine, mesh, admission, controller, health, and stats surface as
+a standalone server; "replica" is a deployment role, not a code path.
+
+Worker usage (what ``spawn_local_replicas`` runs):
+
+    python -m robotic_discovery_platform_tpu.serving.replica \
+        --tracking-uri file:/tmp/mlruns --img-size 64 --window-ms 2 \
+        --slo-ms 250 --port 0 [--force-cpu N] [--warmup WxH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: how long spawn_local_replicas waits for each child's port line
+_SPAWN_TIMEOUT_S = 180.0
+
+#: directory containing the package -- prepended to each child's
+#: PYTHONPATH so `-m ...serving.replica` resolves even when the parent
+#: imported the package off sys.path (uninstalled checkout driven from
+#: elsewhere), the same hermeticity multihost_worker gets from its
+#: explicit sys.path insert
+_PKG_ROOT = str(Path(__file__).resolve().parents[2])
+
+
+def register_tiny_model(root: Path, *, img_size: int = 64,
+                        base_features: int = 8, seed: int = 0) -> str:
+    """Create a file-store registry under ``root`` holding one tiny
+    registered model (staging-aliased) every replica of a local CPU fleet
+    serves -- shared weights are what make the 1-replica fleet path
+    bitwise-comparable to a direct server. Returns the tracking URI.
+    Refactored out of bench_load.boot_smoke_server so fleets, benches,
+    and tests build identical registries."""
+    import jax
+
+    from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    uri = f"file:{root}"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=base_features,
+                       compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = init_unet(model, jax.random.key(seed), img_size=img_size)
+    with tracking.start_run():
+        version = tracking.log_model(
+            variables, mcfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", version
+    )
+    return uri
+
+
+def replica_config(tracking_uri: str, *, port: int = 0,
+                   img_size: int = 64, window_ms: float = 2.0,
+                   max_batch: int = 4, slo_ms: float = 250.0,
+                   workdir: str | None = None, metrics_port: int = 0,
+                   **overrides):
+    """The smoke-scale ServerConfig a local CPU replica boots: tiny model
+    at ``img_size``, micro-batching ON (so the dispatcher, flight
+    recorder, and serving.batch.* fault sites are live), SLO tracking on
+    (the burn gauge is what the fleet controller scrapes), hot-reload
+    polling off."""
+    from robotic_discovery_platform_tpu.utils.config import ServerConfig
+
+    workdir = workdir or tempfile.mkdtemp(prefix="rdp-replica-")
+    return ServerConfig(
+        address=f"localhost:{port}",
+        tracking_uri=tracking_uri,
+        model_img_size=img_size,
+        metrics_csv=str(Path(workdir) / "metrics.csv"),
+        metrics_flush_every=64,
+        calibration_path=str(Path(workdir) / "missing.npz"),
+        batch_window_ms=window_ms,
+        max_batch=max_batch,
+        metrics_port=metrics_port,
+        reload_poll_s=0.0,
+        slo_ms=slo_ms,
+        slo_window=128,
+        slo_budget=0.05,
+        **overrides,
+    )
+
+
+@dataclass
+class LocalReplica:
+    """One spawned replica subprocess and how to reach / restart it."""
+
+    proc: subprocess.Popen
+    endpoint: str
+    port: int
+    argv: list[str] = field(default_factory=list)
+    env: dict = field(default_factory=dict)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        """Abrupt death (SIGKILL): the failure mode the fleet's failover
+        path is built for."""
+        if self.alive():
+            self.proc.kill()
+        self.proc.wait(timeout=30)
+
+    def terminate(self, timeout_s: float = 15.0) -> None:
+        if self.alive():
+            self.proc.terminate()
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def _spawn_one(argv: list[str], env: dict,
+               timeout_s: float) -> tuple[subprocess.Popen, int]:
+    proc = subprocess.Popen(
+        argv, env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
+        text=True,
+    )
+    deadline = time.monotonic() + timeout_s
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.strip():
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"replica exited rc={proc.returncode} before reporting "
+                "its port"
+            )
+    try:
+        port = int(json.loads(line)["port"])
+    except Exception as exc:
+        proc.kill()
+        raise RuntimeError(
+            f"replica did not report a port (got {line!r})"
+        ) from exc
+    return proc, port
+
+
+def spawn_local_replicas(
+    n: int,
+    tracking_uri: str,
+    *,
+    img_size: int = 64,
+    window_ms: float = 2.0,
+    slo_ms: float = 250.0,
+    warmup: tuple[int, int] | None = None,
+    force_cpu: int = 1,
+    per_replica_env: dict[int, dict] | None = None,
+    timeout_s: float = _SPAWN_TIMEOUT_S,
+) -> list[LocalReplica]:
+    """Boot ``n`` replica subprocesses against one shared registry and
+    return them once each has printed its bound port (use
+    :func:`wait_serving` to additionally wait for health SERVING).
+    ``per_replica_env`` overlays extra env vars onto single replicas --
+    how the CI fault leg arms ``RDP_FAULTS`` on exactly one fleet member
+    without touching the others."""
+    replicas: list[LocalReplica] = []
+    try:
+        for i in range(n):
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in (_PKG_ROOT, env.get("PYTHONPATH")) if p
+            )
+            env.update((per_replica_env or {}).get(i, {}))
+            argv = [
+                sys.executable, "-m",
+                "robotic_discovery_platform_tpu.serving.replica",
+                "--tracking-uri", tracking_uri,
+                "--img-size", str(img_size),
+                "--window-ms", str(window_ms),
+                "--slo-ms", str(slo_ms),
+                "--port", "0",
+            ]
+            if force_cpu:
+                argv += ["--force-cpu", str(force_cpu)]
+            if warmup is not None:
+                argv += ["--warmup", f"{warmup[0]}x{warmup[1]}"]
+            proc, port = _spawn_one(argv, env, timeout_s)
+            replicas.append(LocalReplica(
+                proc=proc, endpoint=f"localhost:{port}", port=port,
+                argv=argv, env=env,
+            ))
+            log.info("replica %d up at localhost:%d (pid %d)",
+                     i, port, proc.pid)
+    except Exception:
+        stop_replicas(replicas)
+        raise
+    return replicas
+
+
+def respawn_replica(replica: LocalReplica,
+                    timeout_s: float = _SPAWN_TIMEOUT_S) -> LocalReplica:
+    """Restart a killed replica ON ITS OLD PORT (the fleet's static
+    endpoint list does not change), returning the refreshed handle --
+    how the kill legs prove health-gated rejoin."""
+    argv = list(replica.argv)
+    i = argv.index("--port")
+    argv[i + 1] = str(replica.port)
+    proc, port = _spawn_one(argv, replica.env, timeout_s)
+    if port != replica.port:  # pragma: no cover - bind raced
+        proc.kill()
+        raise RuntimeError(
+            f"respawn bound port {port}, wanted {replica.port}")
+    return LocalReplica(proc=proc, endpoint=replica.endpoint,
+                        port=port, argv=argv, env=replica.env)
+
+
+def wait_serving(endpoints: list[str],
+                 timeout_s: float = _SPAWN_TIMEOUT_S) -> None:
+    """Block until every endpoint's grpc.health.v1 overall status reads
+    SERVING (warm-up done, readiness up)."""
+    import grpc
+
+    from robotic_discovery_platform_tpu.serving import health as health_lib
+    from robotic_discovery_platform_tpu.serving.proto import health_pb2
+
+    deadline = time.monotonic() + timeout_s
+    for ep in endpoints:
+        channel = grpc.insecure_channel(ep)
+        try:
+            stub = health_lib.HealthStub(channel)
+            while True:
+                try:
+                    resp = stub.Check(
+                        health_pb2.HealthCheckRequest(service=""),
+                        timeout=2.0,
+                    )
+                    if resp.status == health_lib.SERVING:
+                        break
+                except grpc.RpcError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replica {ep} not SERVING after {timeout_s:.0f}s")
+                time.sleep(0.1)
+        finally:
+            channel.close()
+
+
+def stop_replicas(replicas: list[LocalReplica]) -> None:
+    for r in replicas:
+        try:
+            r.terminate()
+        except Exception:  # pragma: no cover - teardown best-effort
+            log.exception("replica %s teardown failed", r.endpoint)
+
+
+# -- worker entry ------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Boot one fleet replica (a full serving/server.py "
+                    "process) and print its bound port as one JSON line."
+    )
+    parser.add_argument("--tracking-uri", required=True)
+    parser.add_argument("--img-size", type=int, default=64)
+    parser.add_argument("--window-ms", type=float, default=2.0)
+    parser.add_argument("--max-batch", type=int, default=4)
+    parser.add_argument("--slo-ms", type=float, default=250.0)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument("--force-cpu", type=int, default=0, metavar="N",
+                        help="pin this process to N virtual CPU devices "
+                             "(the local-fleet harness; a real host "
+                             "replica keeps its accelerators)")
+    parser.add_argument("--warmup", default=None, metavar="WxH",
+                        help="pre-compile for a WxH camera before "
+                             "readiness flips (skipped by default so an "
+                             "armed RDP_FAULTS one-shot cannot abort "
+                             "boot; the fleet's warm phase absorbs it)")
+    cli = parser.parse_args(argv)
+
+    if cli.force_cpu:
+        from robotic_discovery_platform_tpu.utils.platforms import (
+            force_cpu_platform,
+        )
+
+        force_cpu_platform(min_devices=cli.force_cpu)
+    else:
+        from robotic_discovery_platform_tpu.utils.platforms import (
+            apply_env_platform,
+        )
+
+        apply_env_platform()
+
+    from robotic_discovery_platform_tpu.serving import server as server_lib
+
+    warmup_shape = None
+    if cli.warmup:
+        w, h = cli.warmup.lower().split("x")
+        warmup_shape = (int(w), int(h))
+    cfg = replica_config(
+        cli.tracking_uri, port=cli.port, img_size=cli.img_size,
+        window_ms=cli.window_ms, max_batch=cli.max_batch,
+        slo_ms=cli.slo_ms, metrics_port=cli.metrics_port,
+    )
+    server, servicer = server_lib.build_server(
+        cfg, warmup_shape=warmup_shape)
+    port = cli.port
+    if port == 0:
+        port = server.add_insecure_port("localhost:0")
+    server.start()
+    print(json.dumps({"port": port, "pid": os.getpid()}), flush=True)
+
+    stopping = []
+
+    def on_term(signum, frame):  # graceful drain on SIGTERM
+        if not stopping:
+            stopping.append(signum)
+            server.stop(grace=cfg.drain_grace_s)
+
+    signal.signal(signal.SIGTERM, on_term)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        server.stop(grace=None)
+    finally:
+        servicer.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,7 @@ Overload control (serving/admission.py, serving/controller.py):
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 from concurrent import futures
@@ -122,6 +123,7 @@ from robotic_discovery_platform_tpu.resilience import (
 )
 from robotic_discovery_platform_tpu.serving import (
     controller as controller_lib,
+    fleet as fleet_lib,
     health as health_lib,
 )
 from robotic_discovery_platform_tpu.ops.pallas import quant
@@ -328,6 +330,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self._streams_cond = threading.Condition()
         self._active_streams = 0
         self._draining = False
+        # frames served over this process's lifetime (every terminal
+        # status); reported over the replica stats RPC so a fleet
+        # front-end can read per-replica progress without scraping
+        # /metrics over HTTP
+        self._frames_total = 0
         self.metrics = metrics or MetricsWriter(
             cfg.metrics_csv, cfg.metrics_flush_every
         )
@@ -777,6 +784,28 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         with self._streams_cond:
             return self._active_streams
 
+    def replica_stats(self) -> dict:
+        """The lightweight per-replica stats payload the fleet front-end
+        scrapes over gRPC (serving/fleet.add_replica_stats_to_server):
+        in-flight streams + error-budget burn feed least-loaded placement
+        and the FleetController's weighted ring; the rest is diagnostics
+        a fleet dashboard wants next to them."""
+        eng = self._engine
+        router = eng.dispatcher.router if eng.dispatcher is not None else None
+        return {
+            "inflight_streams": self.active_streams,
+            "frames_total": self._frames_total,
+            "burn": self.slo.burn if self.slo is not None else 0.0,
+            "slo_ms": self.cfg.slo_ms,
+            "chips": self.serving_chips,
+            "quarantined_chips": (len(router.quarantined)
+                                  if router is not None else 0),
+            "version": self.current_version,
+            "draining": self._draining,
+            "refusing_streams": self._refusing_streams,
+            "pid": os.getpid(),
+        }
+
     def AnalyzeActuatorPerformance(self, request_iterator, context):
         if not self._enter_stream():
             context.abort(grpc.StatusCode.UNAVAILABLE,
@@ -888,6 +917,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     status_label = "error"
                 total_s = time.perf_counter() - t0
                 response.proc_time_ms = total_s * 1e3
+                self._frames_total += 1
                 obs.FRAMES.labels(status=status_label).inc()
                 obs.STAGE_LATENCY.labels(stage="total").observe(total_s)
                 obs.STAGE_LATENCY_SUMMARY.labels(stage="total").observe(
@@ -1300,6 +1330,9 @@ def build_server(
     # standard grpc.health.v1 surface: `grpc_health_probe -addr=...` and
     # Kubernetes native gRPC probes work against this port unmodified
     health_lib.add_HealthServicer_to_server(servicer.health, server)
+    # replica stats next to health: the fleet front-end scrapes in-flight
+    # streams + error-budget burn here to place streams (serving/fleet.py)
+    fleet_lib.add_replica_stats_to_server(server, servicer.replica_stats)
     server.add_insecure_port(cfg.address)
     return server, servicer
 

@@ -357,6 +357,43 @@ class ServerConfig:
     # signal recovers AND this cooldown elapses.
     drift_sustain_s: float = 5.0
     drift_cooldown_s: float = 300.0
+    # -- cross-host serving fleet (serving/fleet.py, serving/frontend.py) ---
+    # Comma-separated replica endpoints ("host:port,host:port") the fleet
+    # front-end fans AnalyzeActuatorPerformance streams out to. Each
+    # endpoint is a full per-host replica server (its own chip mesh,
+    # reached over localhost/DCN gRPC). Empty = this process is a plain
+    # single-host server, exactly today's behavior. The
+    # RDP_FLEET_REPLICAS env var overrides this value.
+    fleet_replicas: str = ""
+    # Membership poll period: every tick each replica's grpc.health.v1
+    # status is checked and its stats RPC scraped; a replica reporting
+    # NOT_SERVING (or unreachable) drops out of the placement ring
+    # exactly like a chip drops out of the chip ring.
+    fleet_poll_s: float = 1.0
+    # Per-probe deadline for the health check / stats scrape RPCs.
+    fleet_probe_timeout_s: float = 1.0
+    # Per-replica circuit breaker (resilience/breaker.py): after this
+    # many consecutive failed probes or stream-level failures the
+    # replica is quarantined out of the ring until a half-open health
+    # probe succeeds after fleet_breaker_reset_s.
+    fleet_breaker_failures: int = 2
+    fleet_breaker_reset_s: float = 5.0
+    # How many times one client stream may fail over to another replica
+    # (in-flight frames are re-sent to the new replica) before its
+    # remaining in-flight frames error-complete instead.
+    fleet_max_failovers: int = 3
+    # Fleet-level SLO controller: consumes each replica's error-budget
+    # burn (scraped via the stats RPC) and de-weights replicas whose
+    # burn approaches 1 so new streams shift away BEFORE the replica
+    # browns out (the PR 7 control loop lifted one level).
+    fleet_controller_enabled: bool = True
+    # De-weighting starts when a replica's burn exceeds this (kept below
+    # the replica's own brownout trigger at burn = 1).
+    fleet_burn_high: float = 0.8
+    # Weight floor: a burning replica keeps at least this share of its
+    # idle placement weight (0 would starve its burn signal, the same
+    # reason brownout rung 3 duty-cycles instead of refusing all).
+    fleet_weight_floor: float = 0.1
     # -- chip quarantine (serving/batching.DeviceRouter) --------------------
     # Per-chip dispatch circuit breaker: after this many consecutive
     # dispatch failures on one mesh chip, that chip is quarantined

@@ -70,3 +70,134 @@ def test_probe_backend_succeeds_and_handles_empty_stderr(monkeypatch):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     with pytest.raises(RuntimeError, match="rc=1"):
         bench._probe_backend(attempts=2, timeout_s=1.0)
+
+
+# -- tunnel-skip rows (the BENCH_r04/r05 failure modes) ----------------------
+
+
+def test_tunnel_error_payloads_carry_skipped_marker():
+    for kind in ("tpu_unavailable", "bench_deadline_exceeded",
+                 "nonfinite_measurement"):
+        p = bench._error_payload(kind, "wedged")
+        assert p["skipped"] == "tunnel", p
+    # real bench bugs are NOT skipped windows
+    assert "skipped" not in bench._error_payload("bench_error", "bug")
+
+
+# -- autotune populate pass (tools/pallas_autotune.py) -----------------------
+
+
+def _autotune():
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                           / "tools"))
+    import pallas_autotune
+
+    return pallas_autotune
+
+
+def _row(op="bspline_design", n=6400, c=16, pallas_ms=1.0, xla_ms=2.0,
+         **extra):
+    return {"op": op, "n": n, "c": c, "pallas_ms": pallas_ms,
+            "xla_ms": xla_ms, **extra}
+
+
+def test_autotune_extracts_measured_winners():
+    at = _autotune()
+    bench_payload = {"geometry": [
+        _row(pallas_ms=1.0, xla_ms=2.0),                 # pallas wins
+        _row(op="bspline_curvature", n=100, c=16,
+             pallas_ms=3.0, xla_ms=1.0),                 # xla wins
+        {"op": "deproject_edge_stats", "h": 240, "w": 320, "stride": 2,
+         "pallas_ms": 1.0, "xla_ms": 1.01},              # noise band
+    ]}
+    entries, rejected = at.extract_overrides(bench_payload)
+    assert rejected == []
+    assert entries["bspline_design:c16:n6400"]["impl"] == "pallas"
+    assert entries["bspline_curvature:c16:n100"]["impl"] == "xla"
+    # inside the 3% band: no override written, default policy runs
+    assert not any(k.startswith("deproject:") for k in entries)
+
+
+def test_autotune_keys_match_lookup_impl():
+    """The whole point: what the tool writes is what resolve_impl reads."""
+    from robotic_discovery_platform_tpu.ops.pallas import tuning
+
+    at = _autotune()
+    entries, _ = at.extract_overrides({"geometry": [
+        {"op": "deproject_edge_stats", "h": 480, "w": 640, "stride": 1,
+         "pallas_ms": 1.0, "xla_ms": 2.0},
+    ]})
+    key = tuning.op_key("deproject", h=480, stride=1, w=640)
+    assert key in entries
+
+
+def test_autotune_rejects_malformed_rows():
+    at = _autotune()
+    bench_payload = {"geometry": [
+        _row(pallas_ms=None),                       # analytic-only row
+        _row(pallas_ms=0.0),                        # wedged-tunnel 0.0
+        _row(pallas_ms=float("nan")),               # non-finite
+        _row(op="conv3x3_bn_relu"),                 # not a geometry op
+        {"op": "bspline_design", "n": "6400", "c": 16,
+         "pallas_ms": 1.0, "xla_ms": 2.0},          # dim not an int
+        "not a dict",
+        _row(),                                     # the one good row
+    ]}
+    entries, rejected = at.extract_overrides(bench_payload)
+    assert len(entries) == 1
+    assert len(rejected) == 6
+    # a skipped section is nothing-to-tune, not a crash
+    entries, rejected = at.extract_overrides(
+        {"geometry": {"skipped": "tunnel"}})
+    assert entries == {} and len(rejected) == 1
+    entries, rejected = at.extract_overrides({})
+    assert entries == {} and len(rejected) == 1
+
+
+def test_autotune_merge_owns_geometry_keys_only():
+    at = _autotune()
+    existing = {
+        "conv3x3:b1:32x32:512->512:bfloat16": {"tile_h": 8},
+        "bspline_design:c16:n6400": {"impl": "xla"},   # stale verdict
+        "deproject:h480:stride1:w640": {"impl": "pallas"},  # now noise
+    }
+    new = {"bspline_design:c16:n6400": {"impl": "pallas"}}
+    merged = at.merge_table(existing, new)
+    # conv tile entries ride along untouched
+    assert merged["conv3x3:b1:32x32:512->512:bfloat16"] == {"tile_h": 8}
+    # owned keys replaced by this pass's verdict...
+    assert merged["bspline_design:c16:n6400"]["impl"] == "pallas"
+    # ...including DROPPING a stale override not re-confirmed
+    assert "deproject:h480:stride1:w640" not in merged
+    diff = at.diff_tables(existing, merged)
+    assert diff["removed"] == ["deproject:h480:stride1:w640"]
+    assert diff["changed"] == ["bspline_design:c16:n6400"]
+
+
+def test_autotune_dry_run_writes_nothing(tmp_path, capsys, monkeypatch):
+    from robotic_discovery_platform_tpu.ops.pallas import tuning
+
+    at = _autotune()
+    bench_file = tmp_path / "PALLASBENCH.json"
+    bench_file.write_text(json.dumps({"geometry": [_row()]}))
+    tune_path = tmp_path / "PALLAS_TUNE.json"
+    monkeypatch.setattr(tuning, "_TUNE_PATH", tune_path)
+    monkeypatch.setattr(at.tuning, "_TUNE_PATH", tune_path)
+    tuning.invalidate_cache()
+    try:
+        rc = at.main(["--bench", str(bench_file), "--dry-run"])
+        assert rc == 0
+        assert not tune_path.exists()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["dry_run"] is True
+        assert out["geometry_overrides"] == 1
+        # a real run writes the table and lookup_impl serves it
+        rc = at.main(["--bench", str(bench_file)])
+        assert rc == 0
+        assert tune_path.exists()
+        assert tuning.lookup_impl(
+            "bspline_design", c=16, n=6400) == "pallas"
+        # unreadable bench file fails structured
+        assert at.main(["--bench", str(tmp_path / "missing.json")]) == 1
+    finally:
+        tuning.invalidate_cache()

@@ -1,0 +1,524 @@
+"""Cross-host serving fleet tests (serving/fleet.py + serving/frontend.py).
+
+Four layers, cheapest first:
+
+- placement units: least-loaded pick with ring tie-break, controller
+  weighting, exclusion -- over fake replicas, no sockets;
+- membership units: health-gated drop-out and half-open rejoin against a
+  real gRPC server exposing ONLY a (fake-driven) health servicer, with an
+  injected breaker clock so no test sleeps through a reset timeout;
+- the replica stats RPC: JSON roundtrip against a bare server and against
+  the real serving stack;
+- live fleet chaos: a 2-replica in-process CPU fleet behind the front-end
+  -- replica killed mid-stream must drop out of placement, its in-flight
+  frames must fail over (a response per accepted frame, none lost), and a
+  replica rebooted on the same port must rejoin via the half-open probe;
+  plus the serial-parity guarantee: a 1-replica depth-1 fleet is bitwise
+  identical to dialing the server directly.
+"""
+
+import queue
+import time
+from concurrent import futures
+
+import grpc
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+from robotic_discovery_platform_tpu.serving import (
+    client as client_lib,
+    fleet as fleet_lib,
+    frontend as frontend_lib,
+    health as health_lib,
+    server as server_lib,
+)
+from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+from robotic_discovery_platform_tpu.utils.config import (
+    ClientConfig,
+    ModelConfig,
+    ServerConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def registered_model(tmp_path_factory):
+    """One tiny registered model every replica in this module serves
+    (shared weights are what make cross-path parity bitwise)."""
+    import jax
+
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+
+    root = tmp_path_factory.mktemp("mlruns")
+    uri = f"file:{root}"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    cfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(cfg)
+    variables = init_unet(model, jax.random.key(0), img_size=64)
+    with tracking.start_run():
+        version = tracking.log_model(
+            variables, cfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", version
+    )
+    return uri
+
+
+def _replica_cfg(uri, tmp_path, name, port=0):
+    return ServerConfig(
+        address=f"localhost:{port}",
+        tracking_uri=uri,
+        metrics_csv=str(tmp_path / f"{name}.csv"),
+        metrics_flush_every=1000,
+        calibration_path=str(tmp_path / "missing.npz"),
+        reload_poll_s=0.0,
+    )
+
+
+def _boot_replica(uri, tmp_path, name, port=0):
+    cfg = _replica_cfg(uri, tmp_path, name, port)
+    server, servicer = server_lib.build_server(cfg)
+    if port == 0:
+        port = server.add_insecure_port("localhost:0")
+    server.start()
+    return server, servicer, f"localhost:{port}", port
+
+
+def _boot_frontend(endpoints, **overrides):
+    cfg = ServerConfig(
+        address="localhost:0",
+        fleet_replicas=",".join(endpoints),
+        fleet_poll_s=overrides.pop("fleet_poll_s", 0.1),
+        fleet_breaker_failures=overrides.pop("fleet_breaker_failures", 1),
+        fleet_breaker_reset_s=overrides.pop("fleet_breaker_reset_s", 0.5),
+        **overrides,
+    )
+    server, fe = frontend_lib.build_frontend(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    return server, fe, f"localhost:{port}"
+
+
+# -- placement units ---------------------------------------------------------
+
+
+def _fake_router(endpoints=("a:1", "b:2", "c:3"), **kw):
+    router = fleet_lib.FleetRouter(list(endpoints), **kw)
+    for r in router.replicas:
+        r.serving = True
+    return router
+
+
+def test_resolve_fleet_replicas_env_override(monkeypatch):
+    monkeypatch.delenv("RDP_FLEET_REPLICAS", raising=False)
+    assert fleet_lib.resolve_fleet_replicas("") == []
+    assert fleet_lib.resolve_fleet_replicas(" a:1, b:2 ,") == ["a:1", "b:2"]
+    monkeypatch.setenv("RDP_FLEET_REPLICAS", "x:9,y:8")
+    assert fleet_lib.resolve_fleet_replicas("a:1") == ["x:9", "y:8"]
+
+
+def test_idle_picks_walk_the_ring():
+    router = _fake_router()
+    picks = []
+    for _ in range(3):
+        r = router.pick()
+        picks.append(r.endpoint)
+        router.release(r)  # back to idle: the tie-break must still walk
+    assert picks == ["a:1", "b:2", "c:3"]
+
+
+def test_least_loaded_wins_over_ring_position():
+    router = _fake_router()
+    router.replicas[0].inflight = 4
+    router.replicas[1].inflight = 1
+    router.replicas[2].inflight = 3
+    assert router.pick().endpoint == "b:2"
+
+
+def test_weight_scales_effective_load():
+    router = _fake_router(("a:1", "b:2"))
+    # equal raw load, but a de-weighted (burning) replica looks busier
+    router.replicas[0].inflight = 2
+    router.replicas[1].inflight = 2
+    router.replicas[0].weight = 0.4
+    assert router.pick().endpoint == "b:2"
+
+
+def test_pick_skips_unplaceable_and_exclude():
+    router = _fake_router()
+    router.replicas[0].serving = False
+    r = router.pick(exclude=router.replicas[1])
+    assert r.endpoint == "c:3"
+    router.replicas[2].serving = False
+    assert router.pick(exclude=router.replicas[1]) is None
+    # nothing placeable at all
+    router.replicas[1].serving = False
+    assert router.pick() is None
+
+
+def test_pick_and_release_track_inflight():
+    router = _fake_router(("a:1", "b:2"))
+    r1, r2 = router.pick(), router.pick()
+    assert {r1.endpoint, r2.endpoint} == {"a:1", "b:2"}
+    assert r1.inflight == r2.inflight == 1
+    router.release(r1)
+    assert r1.inflight == 0
+    assert router.pick() is r1  # emptiest again
+
+
+def test_controller_target_weights_and_actions():
+    c = fleet_lib.FleetController(burn_high=0.8, weight_floor=0.1)
+    assert c.target_weight(0.0) == 1.0
+    assert c.target_weight(0.8) == 1.0
+    assert c.target_weight(1.6) == pytest.approx(0.5)
+    assert c.target_weight(100.0) == 0.1  # floored
+    router = _fake_router(("a:1", "b:2"))
+    router.replicas[0].burn = 1.6
+    before = c.actions_total
+    c.rebalance(router.replicas)
+    assert router.replicas[0].weight == pytest.approx(0.5)
+    assert router.replicas[1].weight == 1.0
+    assert c.actions_total == before + 1
+    # recovery re-weights back to full share
+    router.replicas[0].burn = 0.2
+    c.rebalance(router.replicas)
+    assert router.replicas[0].weight == 1.0
+    assert c.actions_total == before + 2
+
+
+def test_controller_rejects_bad_floor():
+    with pytest.raises(ValueError):
+        fleet_lib.FleetController(weight_floor=0.0)
+
+
+def test_router_requires_endpoints():
+    with pytest.raises(ValueError):
+        fleet_lib.FleetRouter([])
+    with pytest.raises(ValueError):
+        frontend_lib.build_frontend(ServerConfig(fleet_replicas=""))
+
+
+# -- stats RPC ---------------------------------------------------------------
+
+
+def test_replica_stats_rpc_roundtrip():
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    payload = {"burn": 1.5, "inflight_streams": 2, "frames_total": 7}
+    fleet_lib.add_replica_stats_to_server(server, lambda: payload)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    channel = grpc.insecure_channel(f"localhost:{port}")
+    try:
+        stats = fleet_lib.fetch_replica_stats(
+            fleet_lib.ReplicaStatsStub(channel), timeout_s=5.0)
+        assert stats == payload
+    finally:
+        channel.close()
+        server.stop(grace=None)
+
+
+# -- health-gated membership -------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def health_only_server():
+    """A gRPC server exposing ONLY grpc.health.v1 (no vision service, no
+    stats): the membership poller's world model of a replica."""
+    health = health_lib.HealthServicer()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    health_lib.add_HealthServicer_to_server(health, server)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    yield health, f"localhost:{port}"
+    server.stop(grace=None)
+
+
+def test_membership_drop_out_and_half_open_rejoin(health_only_server):
+    health, endpoint = health_only_server
+    clock = _FakeClock()
+    events = []
+    router = fleet_lib.FleetRouter(
+        [endpoint], breaker_failures=2, breaker_reset_s=5.0, clock=clock,
+        on_membership=events.append,
+    )
+    r = router.replicas[0]
+    try:
+        # not serving yet (health starts NOT_SERVING)
+        assert router.poll_once() == 0
+        health.set("", health_lib.SERVING)
+        assert router.poll_once() == 1
+        assert r.placeable
+        assert events[-1] == 1
+
+        # serving status flips NOT_SERVING -> immediate drop-out, and
+        # repeated failed polls open the breaker
+        health.set("", health_lib.NOT_SERVING)
+        assert router.poll_once() == 0
+        assert not r.placeable
+        router.poll_once()  # second failure trips the 2-failure breaker
+        assert r.breaker.state == "open"
+        assert events[-1] == 0
+
+        # recovery: healthy again, but the open breaker holds it out of
+        # the ring until the reset timeout admits a half-open probe
+        health.set("", health_lib.SERVING)
+        assert router.poll_once() == 0
+        assert r.serving and not r.placeable
+        assert router.quarantined_count == 1
+        clock.t += 5.1  # past reset: the next health poll IS the probe
+        assert router.poll_once() == 1
+        assert r.placeable
+        assert router.quarantined_count == 0
+        assert events[-1] == 1
+    finally:
+        router.stop()
+
+
+def test_stream_error_quarantines_without_waiting_for_poll(
+        health_only_server):
+    health, endpoint = health_only_server
+    clock = _FakeClock()
+    router = fleet_lib.FleetRouter(
+        [endpoint], breaker_failures=1, breaker_reset_s=5.0, clock=clock)
+    r = router.replicas[0]
+    try:
+        health.set("", health_lib.SERVING)
+        router.poll_once()
+        assert r.placeable
+        router.on_stream_error(r, RuntimeError("stream died"))
+        assert not r.placeable  # out of the ring before any health tick
+        assert router.pick() is None
+    finally:
+        router.stop()
+
+
+# -- live fleet --------------------------------------------------------------
+
+
+def _encode_frames(n, seed, width=160, height=120):
+    src = SyntheticSource(width=width, height=height, seed=seed,
+                         n_frames=n)
+    src.start()
+    reqs = []
+    for _ in range(n):
+        color, depth = src.get_frames()
+        reqs.append(client_lib.encode_request(color, depth))
+    src.stop()
+    return reqs
+
+
+def test_real_server_exposes_replica_stats(registered_model, tmp_path):
+    server, servicer, endpoint, _ = _boot_replica(
+        registered_model, tmp_path, "stats")
+    channel = grpc.insecure_channel(endpoint)
+    try:
+        stats = fleet_lib.fetch_replica_stats(
+            fleet_lib.ReplicaStatsStub(channel), timeout_s=10.0)
+        assert stats["inflight_streams"] == 0
+        assert stats["frames_total"] == 0
+        assert stats["burn"] == 0.0  # no SLO configured
+        assert stats["chips"] == 1
+        assert stats["draining"] is False
+        assert "version" in stats
+    finally:
+        channel.close()
+        server.stop(grace=None)
+        servicer.close()
+
+
+def test_one_replica_fleet_is_bitwise_identical_to_direct(
+        registered_model, tmp_path):
+    """Acceptance: the 1-replica fleet path (serial, depth-1 -- no
+    batching, no failover) relays the exact bytes the direct server
+    produces."""
+    d_server, d_servicer, d_endpoint, _ = _boot_replica(
+        registered_model, tmp_path, "direct")
+    r_server, r_servicer, r_endpoint, _ = _boot_replica(
+        registered_model, tmp_path, "replica")
+    f_server = fe = None
+    try:
+        f_server, fe, f_endpoint = _boot_frontend([r_endpoint])
+        assert fe.router.wait_live(1, timeout_s=10)
+        # front-end readiness tracks membership
+        assert fe.health.get("") == health_lib.SERVING
+
+        def run(addr, seed=11):
+            return client_lib.run_client(
+                ClientConfig(server_address=addr,
+                             calibration_path="nonexistent.npz"),
+                source=SyntheticSource(width=160, height=120, seed=seed,
+                                       n_frames=4),
+                max_frames=4,
+            )
+
+        direct = run(d_endpoint)
+        fleet = run(f_endpoint)
+        assert len(direct) == len(fleet) == 4
+        for a, b in zip(direct, fleet):
+            assert a.status == b.status
+            assert a.status.startswith(("OK", "DEGRADED"))
+            # proto float32 fields compare bitwise via ==
+            assert a.mean_curvature == b.mean_curvature
+            assert a.max_curvature == b.max_curvature
+            assert a.mask_coverage == b.mask_coverage
+            assert a.mask_png == b.mask_png  # the whole mask, bytewise
+            assert np.array_equal(a.spline_points, b.spline_points)
+        # every frame was placed on (and counted against) the one replica
+        assert fe.router.replicas[0].frames == 4
+        assert fe.router.failovers_total == 0
+    finally:
+        if f_server is not None:
+            f_server.stop(grace=None)
+            fe.close()
+        for s, sv in ((d_server, d_servicer), (r_server, r_servicer)):
+            s.stop(grace=None)
+            sv.close()
+
+
+def test_replica_kill_fails_over_and_rejoins(registered_model, tmp_path,
+                                             monkeypatch):
+    """Acceptance chaos leg, in-process: kill the replica a live stream
+    is placed on WHILE a frame is in flight there (pinned in the analyze
+    stage by an injected slow fault, so the kill deterministically
+    strands it). The in-flight frame must fail over to the surviving
+    replica (a response per accepted frame -- none lost, none hung), the
+    dead replica must leave placement, and a server rebooted on the same
+    port must rejoin through the half-open probe."""
+    from robotic_discovery_platform_tpu.resilience import faults
+
+    s1, sv1, ep1, port1 = _boot_replica(registered_model, tmp_path, "r1")
+    s2, sv2, ep2, port2 = _boot_replica(registered_model, tmp_path, "r2")
+    servers = {ep1: (s1, sv1), ep2: (s2, sv2)}
+    f_server = fe = None
+    rejoined_server = rejoined_servicer = None
+    channel = None
+    try:
+        f_server, fe, f_endpoint = _boot_frontend([ep1, ep2])
+        assert fe.router.wait_live(2, timeout_s=10)
+
+        reqs = _encode_frames(3, seed=21)
+        channel = grpc.insecure_channel(f_endpoint)
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        outbox: queue.Queue = queue.Queue()
+
+        def gen():
+            while True:
+                item = outbox.get()
+                if item is None:
+                    return
+                yield item
+
+        responses = stub.AnalyzeActuatorPerformance(gen())
+        outbox.put(reqs[0])
+        r0 = next(responses)
+        assert r0.status.startswith(("OK", "DEGRADED"))
+
+        # the stream is placed on exactly one replica; kill THAT one
+        placed = [r for r in fe.router.replicas if r.inflight > 0]
+        assert len(placed) == 1
+        victim = placed[0]
+        victim_port = port1 if victim.endpoint == ep1 else port2
+        vs, vsv = servers[victim.endpoint]
+
+        # pin the NEXT frame inside the victim's analyze stage (one slow
+        # fault), then kill the victim the moment the fault has fired --
+        # the frame is deterministically in flight on a dead replica
+        monkeypatch.setenv("RDP_FAULT_SLOW_S", "2.0")
+        faults.configure_faults("serving.analyze:slow:1")
+        try:
+            outbox.put(reqs[1])
+            deadline = time.monotonic() + 10.0
+            while (faults.fired("serving.analyze") < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert faults.fired("serving.analyze") >= 1
+            vs.stop(grace=None)  # abrupt: the in-flight RPC dies mid-frame
+
+            # the in-flight frame must complete -- rerouted to the
+            # survivor (OK) or error-completed (ERROR), never silently
+            # lost
+            r1 = next(responses)
+        finally:
+            faults.configure_faults(None)
+        assert r1.status.startswith(("OK", "DEGRADED", "ERROR"))
+        assert fe.router.failovers_total >= 1
+        assert not victim.placeable  # breaker opened on the stream error
+
+        # and the stream keeps serving on the survivor
+        outbox.put(reqs[2])
+        r2 = next(responses)
+        assert r2.status.startswith(("OK", "DEGRADED"))
+        outbox.put(None)
+        leftovers = list(responses)  # clean half-close, no stragglers
+        assert leftovers == []
+        vsv.close()
+
+        # 3 accepted frames -> 3 responses: zero lost
+        frames_relayed = sum(r.frames for r in fe.router.replicas)
+        reroutes = fe.router.failover_frames_rerouted
+        errored = fe.router.failover_frames_error_completed
+        assert frames_relayed + errored >= 3
+        assert reroutes + errored >= 1  # the kill had a frame in flight
+
+        # rejoin: reboot a replica on the SAME port; the half-open probe
+        # must reinstate it within a few poll ticks
+        rejoined_server, rejoined_servicer, _, _ = _boot_replica(
+            registered_model, tmp_path, "r1b", port=victim_port)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not victim.placeable:
+            time.sleep(0.1)
+        assert victim.placeable, "killed replica never rejoined the ring"
+        assert fe.router.live_count == 2
+    finally:
+        if channel is not None:
+            channel.close()
+        if f_server is not None:
+            f_server.stop(grace=None)
+            fe.close()
+        for s, sv in servers.values():
+            s.stop(grace=None)
+            try:
+                sv.close()
+            except Exception:
+                pass
+        if rejoined_server is not None:
+            rejoined_server.stop(grace=None)
+            rejoined_servicer.close()
+
+
+def test_frontend_aborts_with_no_live_replica(registered_model, tmp_path):
+    """An empty ring fails fast with UNAVAILABLE (clients' retry policy
+    treats it as a setup failure and backs off), and the front-end's own
+    health reads NOT_SERVING."""
+    f_server = fe = None
+    try:
+        # endpoint nobody listens on
+        f_server, fe, f_endpoint = _boot_frontend(["localhost:1"])
+        time.sleep(0.3)  # a couple of poll ticks
+        assert fe.router.live_count == 0
+        assert fe.health.get("") == health_lib.NOT_SERVING
+        channel = grpc.insecure_channel(f_endpoint)
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        reqs = _encode_frames(1, seed=5)
+        with pytest.raises(grpc.RpcError) as err:
+            list(stub.AnalyzeActuatorPerformance(iter(reqs)))
+        assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        channel.close()
+    finally:
+        if f_server is not None:
+            f_server.stop(grace=None)
+            fe.close()
