@@ -1,0 +1,850 @@
+"""rdp-racecheck: static concurrency analysis for the serving stack.
+
+The platform runs ~10 thread-spawning modules over ~30 lock sites
+(collector/completer/watchdog, controller ticks, fleet pump threads,
+health pollers, metrics/recorder); one inconsistent acquisition order or
+one unguarded shared-field mutation can deadlock or corrupt the fleet
+under exactly the overload/chaos conditions the resilience layer was
+built for. jaxlint answers "is the jit discipline sound"; this module
+answers "is the concurrency discipline sound", statically, over the
+whole package:
+
+========  ========  ====================================================
+rule      severity  fires on
+========  ========  ====================================================
+RC001     error     potential deadlock: a cycle in the whole-package
+                    lock-acquisition-order graph (lock B acquired while
+                    holding A on one path, A while holding B on
+                    another), built from ``with <lock>:`` /
+                    ``.acquire()`` nesting plus a callgraph
+                    approximation (self-methods, module functions, and
+                    attributes whose class is constructed or annotated
+                    in the package)
+RC002     error     a field declared ``# guarded_by: <lock>`` mutated
+                    outside a ``with <lock>:`` block (and outside
+                    ``__init__``, ``*_locked`` methods, and defs whose
+                    own ``# guarded_by:`` annotation says the caller
+                    holds the lock)
+RC003     error     a blocking call under a held lock: ``queue.get``
+                    (not ``get_nowait``), ``.result()``, ``.join()``,
+                    ``.wait()`` on anything but the held condition,
+                    ``time.sleep``, ``np.asarray`` (a D2H sync when the
+                    value is a device array), ``jax.device_get``,
+                    ``.block_until_ready()``, HTTP/subprocess calls --
+                    every other thread needing the lock stalls for the
+                    call's duration
+========  ========  ====================================================
+
+The ``# guarded_by: <lock>`` convention:
+
+- on a ``self.<field> = ...`` line (typically in ``__init__``): the
+  field may only be mutated with ``<lock>`` (an attribute of the same
+  object) held -- RC002 checks every mutation site in the class;
+- on a ``def`` line: the method runs with ``<lock>`` already held by its
+  callers (the ``*_locked`` suffix convention, spelled out) -- its body
+  counts as lock-held for RC002/RC003 and contributes order-graph edges.
+
+Suppression mirrors jaxlint: ``# racecheck: disable=RC003`` inline, or a
+baseline file (default ``.racecheck-baseline.json``) whose every entry
+carries a non-empty justification; stale entries fail the run, so the
+baseline only shrinks.
+
+The runtime half of this tooling lives in ``utils/lockcheck.py``
+(``RDP_LOCKCHECK=strict`` instrumented locks) and
+``utils/transferguard.py`` (``RDP_TRANSFER_GUARD=strict`` around the hot
+jitted entries): static analysis proves the lexical discipline, the
+sanitizers catch what dynamic callgraphs hide.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from robotic_discovery_platform_tpu.analysis.linter import (
+    _baseline_key,
+    iter_python_files,
+    load_baseline,
+)
+from robotic_discovery_platform_tpu.analysis.rules import ERROR, Finding
+
+BASELINE_NAME = ".racecheck-baseline.json"
+
+RC_RULES = {
+    "RC001": "potential deadlock: lock-order cycle",
+    "RC002": "guarded field mutated without its lock",
+    "RC003": "blocking call under a held lock",
+}
+
+_DISABLE_RE = re.compile(r"#\s*racecheck:\s*disable(?:=([A-Z0-9, ]+))?")
+_GUARDED_BY_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+#: constructors that make a lock-like object we track in the order graph
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+#: constructors of blocking queues (``.get`` under a lock is RC003)
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                "DeadlineQueue"}
+#: dotted-call names that block
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "numpy.asarray", "numpy.array",
+    "jax.device_get", "jax.block_until_ready",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.request", "urllib.request.urlopen",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+}
+#: attribute-call names that block regardless of receiver type
+_BLOCKING_ATTRS = {"result", "join", "block_until_ready",
+                   "wait_for_termination"}
+
+_MUTATING_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "update",
+    "setdefault", "clear", "pop", "popleft", "popitem", "remove", "add",
+    "discard",
+}
+
+
+# -- per-module model --------------------------------------------------------
+
+
+@dataclass
+class ClassInfo:
+    module: str          # short module name, e.g. "batching"
+    name: str            # class name
+    locks: dict = field(default_factory=dict)       # attr -> kind
+    queues: set = field(default_factory=set)        # queue-typed attrs
+    guarded: dict = field(default_factory=dict)     # field -> lock attr
+    attr_types: dict = field(default_factory=dict)  # attr -> ClassName
+    methods: dict = field(default_factory=dict)     # name -> FunctionDef
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module}.{self.name}"
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.qualname}.{attr}"
+
+
+@dataclass
+class ModuleModel:
+    path: str
+    short: str                       # basename without .py
+    tree: ast.Module
+    comments: dict                   # lineno -> guarded_by attr
+    disabled: dict                   # lineno -> set of rules | None (=all)
+    classes: dict = field(default_factory=dict)      # name -> ClassInfo
+    functions: dict = field(default_factory=dict)    # name -> FunctionDef
+    module_locks: set = field(default_factory=set)   # module-global locks
+
+
+@dataclass
+class CallEvent:
+    held: tuple          # held lock keys at the call site
+    callee: tuple | None  # ("class", qualclass, method) | ("func", mod, name)
+    node: ast.AST
+
+
+@dataclass
+class FunctionSummary:
+    """What one function does with locks, for the cross-function pass."""
+
+    qual: str                       # "mod.Class.method" or "mod.func"
+    acquires: set = field(default_factory=set)   # lock ids acquired inside
+    calls: list = field(default_factory=list)    # CallEvent list
+    edges: list = field(default_factory=list)    # (held_id, lock_id, node)
+
+
+def _comment_maps(source: str):
+    """lineno -> guarded_by attr, and lineno -> disabled rule set."""
+    guards: dict[int, str] = {}
+    disabled: dict[int, set | None] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        g = _GUARDED_BY_RE.search(line)
+        if g:
+            guards[i] = g.group(1)
+        d = _DISABLE_RE.search(line)
+        if d:
+            rules = d.group(1)
+            disabled[i] = (
+                {r.strip() for r in rules.split(",") if r.strip()}
+                if rules else None
+            )
+    return guards, disabled
+
+
+def _ctor_name(value: ast.AST) -> str | None:
+    """Trailing name of a constructor call: ``threading.Lock()`` ->
+    "Lock", ``lockcheck.checked_lock("x")`` -> "checked_lock"."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    while isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name) or isinstance(f.value, ast.Attribute):
+            pass
+        break
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _self_attr_target(node: ast.AST, selfname: str = "self") -> str | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == selfname):
+        return node.attr
+    return None
+
+
+def _build_class_info(mod: ModuleModel, cls: ast.ClassDef) -> ClassInfo:
+    info = ClassInfo(mod.short, cls.name)
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+    # lock/queue/guarded/attr-type discovery over every method body (locks
+    # are normally created in __init__, but JL013 exists precisely because
+    # they sometimes are not)
+    for m in info.methods.values():
+        # constructor params annotated as locks: self._lock = lock
+        lock_params = {
+            a.arg for a in m.args.args
+            if a.annotation is not None
+            and "Lock" in ast.unparse(a.annotation)
+        }
+        for node in ast.walk(m):
+            targets: list[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for t in targets:
+                attr = _self_attr_target(t)
+                if attr is None:
+                    continue
+                ctor = _ctor_name(value)
+                if ctor in _LOCK_CTORS or ctor == "checked_lock":
+                    info.locks[attr] = ctor
+                elif ctor in _QUEUE_CTORS:
+                    info.queues.add(attr)
+                    # package-local queue classes (DeadlineQueue) also
+                    # resolve as callees so their internal lock shows in
+                    # the order graph
+                    info.attr_types.setdefault(attr, ctor)
+                elif (isinstance(value, ast.Name)
+                        and value.id in lock_params):
+                    info.locks[attr] = "Lock"
+                elif ctor is not None and ctor[:1].isupper():
+                    # best-effort attr type for callee resolution
+                    info.attr_types.setdefault(attr, ctor)
+                # guarded_by declaration on this assignment's line
+                guard = mod.comments.get(node.lineno)
+                if guard is not None:
+                    info.guarded[attr] = guard
+    return info
+
+
+def build_module_model(source: str, path: str) -> ModuleModel:
+    tree = ast.parse(source, filename=path)
+    comments, disabled = _comment_maps(source)
+    short = Path(path).stem
+    mod = ModuleModel(path=path, short=short, tree=tree,
+                      comments=comments, disabled=disabled)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            mod.classes[node.name] = _build_class_info(mod, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions[node.name] = node
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name)
+                        and _ctor_name(node.value) in (
+                            _LOCK_CTORS | {"checked_lock"})):
+                    mod.module_locks.add(t.id)
+    return mod
+
+
+# -- per-function lock walk --------------------------------------------------
+
+
+class _FunctionWalker:
+    """Statement-ordered walk of one function body carrying the held-lock
+    stack; produces acquisition edges, call events, RC002/RC003 findings.
+
+    Held locks are (lock_id, receiver) pairs: the class-level id feeds the
+    global order graph, the receiver string ("self", "st", ...) makes the
+    guarded-field check object-accurate."""
+
+    def __init__(self, mod: ModuleModel, cls: ClassInfo | None,
+                 fn: ast.FunctionDef, out: list[Finding],
+                 summary: FunctionSummary, local_types: dict):
+        self.mod = mod
+        self.cls = cls
+        self.fn = fn
+        self.out = out
+        self.summary = summary
+        # local var -> ClassName (from annotations and constructions)
+        self.local_types = local_types
+        self.held: list[tuple[str, str, str]] = []  # (id, receiver, kind)
+        # caller-holds: a guarded_by comment on the def line
+        guard = mod.comments.get(fn.lineno)
+        if guard is not None and cls is not None and guard in cls.locks:
+            self.held.append((cls.lock_id(guard), "self",
+                              cls.locks[guard]))
+
+    # -- resolution helpers -------------------------------------------------
+
+    def _receiver_class(self, node: ast.AST) -> ClassInfo | None:
+        """The ClassInfo a ``x`` or ``self._attr`` receiver refers to."""
+        if isinstance(node, ast.Name):
+            if node.id == "self" and self.cls is not None:
+                return self.cls
+            tname = self.local_types.get(node.id)
+            return self._class_by_name(tname)
+        attr = _self_attr_target(node)
+        if attr is not None and self.cls is not None:
+            return self._class_by_name(self.cls.attr_types.get(attr))
+        return None
+
+    def _class_by_name(self, name: str | None) -> ClassInfo | None:
+        if name is None:
+            return None
+        return _PACKAGE_CLASSES.get(name)
+
+    def _lock_of(self, expr: ast.AST):
+        """(lock_id, receiver, kind) when ``expr`` is a known lock."""
+        if isinstance(expr, ast.Name) and expr.id in self.mod.module_locks:
+            return (f"{self.mod.short}.{expr.id}", expr.id, "Lock")
+        if isinstance(expr, ast.Attribute):
+            owner = self._receiver_class(expr.value)
+            if owner is not None and expr.attr in owner.locks:
+                return (owner.lock_id(expr.attr),
+                        ast.unparse(expr.value), owner.locks[expr.attr])
+        return None
+
+    def _suppressed(self, node: ast.AST, rule: str) -> bool:
+        lineno = getattr(node, "lineno", -1)
+        if lineno not in self.mod.disabled:
+            return False
+        rules = self.mod.disabled[lineno]
+        return rules is None or rule in rules  # None = all rules
+
+    def finding(self, node: ast.AST, rule: str, msg: str) -> None:
+        if self._suppressed(node, rule):
+            return
+        self.out.append(Finding(self.mod.path, node.lineno,
+                                node.col_offset, rule, ERROR, msg))
+
+    # -- events --------------------------------------------------------------
+
+    def _on_acquire(self, lock, node: ast.AST) -> None:
+        lock_id = lock[0]
+        self.summary.acquires.add(lock_id)
+        for (held_id, _recv, _kind) in self.held:
+            if held_id != lock_id:
+                self.summary.edges.append((held_id, lock_id, node))
+
+    def _on_call(self, node: ast.Call) -> None:
+        callee = None
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in self.mod.functions:
+                callee = ("func", self.mod.short, f.id)
+        elif isinstance(f, ast.Attribute):
+            owner = self._receiver_class(f.value)
+            if owner is not None and f.attr in owner.methods:
+                callee = ("class", owner.qualname, f.attr)
+        if callee is not None:
+            self.summary.calls.append(CallEvent(
+                held=tuple(h[0] for h in self.held), callee=callee,
+                node=node,
+            ))
+
+    def _blocking_reason(self, node: ast.Call) -> str | None:
+        """Why this call blocks, or None. ``.wait()`` on a held condition
+        is exempt (it releases the lock while waiting)."""
+        f = node.func
+        dotted = _dotted_name(f)
+        if dotted in _BLOCKING_CALLS or (
+                dotted is not None
+                and dotted.replace("np.", "numpy.") in _BLOCKING_CALLS):
+            return f"{dotted}()"
+        if isinstance(f, ast.Attribute):
+            if f.attr in _BLOCKING_ATTRS:
+                return f".{f.attr}()"
+            if f.attr == "wait":
+                lock = self._lock_of(f.value)
+                if lock is not None and any(
+                        h[0] == lock[0] for h in self.held):
+                    return None  # Condition.wait releases the held lock
+                return ".wait()"
+            if f.attr == "get":
+                owner_attr = None
+                if isinstance(f.value, ast.Attribute):
+                    owner = self._receiver_class(f.value.value)
+                    if owner is not None and f.value.attr in owner.queues:
+                        owner_attr = f.value.attr
+                if owner_attr is not None:
+                    return f".{owner_attr}.get()"
+        return None
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        if not self.held:
+            return
+        reason = self._blocking_reason(node)
+        if reason is None:
+            return
+        held_names = ", ".join(sorted({h[0] for h in self.held}))
+        self.finding(
+            node, "RC003",
+            f"blocking call {reason} while holding {held_names}; every "
+            "thread contending on that lock stalls for the call's "
+            "duration -- move the blocking work outside the lock",
+        )
+
+    def _check_mutation(self, target: ast.AST, node: ast.AST) -> None:
+        """RC002 on a mutation of a guarded field."""
+        # normalize: x.field[...] = / x.field += / x.field = / x.field.m()
+        expr = target
+        if isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if not isinstance(expr, ast.Attribute):
+            return
+        owner = self._receiver_class(expr.value)
+        if owner is None:
+            return
+        guard = owner.guarded.get(expr.attr)
+        if guard is None:
+            return
+        if self.fn.name == "__init__" or self.fn.name.endswith("_locked"):
+            return
+        receiver = ast.unparse(expr.value)
+        want = owner.lock_id(guard)
+        if any(h[0] == want and h[1] == receiver for h in self.held):
+            return
+        # receiver mismatch but lock held at all (e.g. router lock guards
+        # replica fields): accept when the lock itself is held anywhere
+        if any(h[0] == want for h in self.held):
+            return
+        self.finding(
+            node, "RC002",
+            f"{receiver}.{expr.attr} is declared guarded_by {guard!r} but "
+            f"is mutated here without {want} held",
+        )
+
+    # -- the walk ------------------------------------------------------------
+
+    def walk(self) -> None:
+        self._walk_block(self.fn.body)
+
+    def _walk_block(self, stmts: list[ast.stmt]) -> None:
+        acquired_here: list[tuple] = []
+        for stmt in stmts:
+            self._walk_stmt(stmt, acquired_here)
+        for _ in acquired_here:
+            self.held.pop()
+
+    def _walk_stmt(self, stmt: ast.stmt, acquired_here: list) -> None:
+        if isinstance(stmt, ast.With):
+            pushed = 0
+            for item in stmt.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self._on_acquire(lock, stmt)
+                    self.held.append(lock)
+                    pushed += 1
+                else:
+                    self._visit_expr(item.context_expr)
+            self._walk_block(stmt.body)
+            for _ in range(pushed):
+                self.held.pop()
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: walked separately with a fresh stack? No --
+            # closures typically run later on another thread; analyzing
+            # them under the current held set would be wrong. Walk with
+            # an empty held stack but the same summary.
+            saved, self.held = self.held, []
+            self._walk_block(stmt.body)
+            self.held = saved
+            return
+        # bare .acquire() / .release() statements pair lexically within
+        # one block
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                lock = self._lock_of(f.value)
+                if lock is not None:
+                    self._on_acquire(lock, stmt)
+                    self.held.append(lock)
+                    acquired_here.append(lock)
+                    self._visit_expr(call)
+                    return
+            if isinstance(f, ast.Attribute) and f.attr == "release":
+                lock = self._lock_of(f.value)
+                if lock is not None and acquired_here:
+                    if self.held and self.held[-1][0] == lock[0]:
+                        self.held.pop()
+                        acquired_here.pop()
+                    return
+        # compound statements recurse into their blocks with the same
+        # held stack; their header expressions (test/iter) are visited too
+        for header in ("test", "iter"):
+            sub = getattr(stmt, header, None)
+            if sub is not None:
+                self._visit_expr(sub)
+        for block in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, block, None)
+            if sub:
+                self._walk_block(sub)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._walk_block(handler.body)
+        # local type bindings (x = ClassName(...)) feed receiver typing
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call):
+            ctor = _ctor_name(stmt.value)
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and ctor and ctor[:1].isupper():
+                    self.local_types.setdefault(t.id, ctor)
+        # mutations
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                self._check_mutation(t, stmt)
+        # expressions in this statement (calls, mutating methods)
+        if not getattr(stmt, "body", None):
+            self._visit_expr(stmt)
+
+    def _visit_expr(self, root: ast.AST) -> None:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            self._on_call(node)
+            self._check_blocking(node)
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATING_METHODS):
+                self._check_mutation(f.value, node)
+
+
+def _dotted_name(node: ast.AST) -> str | None:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+# -- whole-package pass ------------------------------------------------------
+
+# class name -> ClassInfo for the modules under analysis (module-level so
+# the walker can resolve cross-module receivers; rebuilt per analyze run)
+_PACKAGE_CLASSES: dict[str, ClassInfo] = {}
+
+
+@dataclass
+class LockGraph:
+    """The package lock-order graph: edge (a, b) = "b acquired while a
+    held", with one representative site per edge."""
+
+    edges: dict = field(default_factory=dict)  # (a, b) -> (path, lineno)
+
+    def add(self, a: str, b: str, path: str, lineno: int) -> None:
+        self.edges.setdefault((a, b), (path, lineno))
+
+    def cycles(self) -> list[list[str]]:
+        """Elementary cycles (as lock-id lists) via DFS; deduplicated by
+        rotation-normalized membership."""
+        graph: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        seen_cycles: set[tuple] = set()
+        out: list[list[str]] = []
+
+        def dfs(start: str, node: str, path: list[str],
+                on_path: set[str]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start and len(path) >= 1:
+                    cyc = path[:]
+                    k = min(range(len(cyc)), key=lambda i: cyc[i])
+                    key = tuple(cyc[k:] + cyc[:k])
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(cyc + [start])
+                elif nxt not in on_path and nxt > start:
+                    # only explore nodes > start so each cycle is found
+                    # exactly once (from its smallest node)
+                    path.append(nxt)
+                    on_path.add(nxt)
+                    dfs(start, nxt, path, on_path)
+                    on_path.discard(nxt)
+                    path.pop()
+
+        for start in sorted(graph):
+            dfs(start, start, [start], {start})
+        return out
+
+
+@dataclass
+class RacecheckResult:
+    findings: list
+    graph: LockGraph
+    modules: dict
+
+
+def analyze_paths(paths: list[str]) -> RacecheckResult:
+    """Parse every module under ``paths`` and run the three checks."""
+    files = iter_python_files(paths)
+    modules: dict[str, ModuleModel] = {}
+    findings: list[Finding] = []
+    for f_path in files:
+        try:
+            source = f_path.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            mod = build_module_model(source, str(f_path))
+        except SyntaxError as exc:
+            findings.append(Finding(str(f_path), exc.lineno or 1, 0,
+                                    "RC000", ERROR,
+                                    f"syntax error: {exc.msg}"))
+            continue
+        modules[str(f_path)] = mod
+
+    _PACKAGE_CLASSES.clear()
+    for mod in modules.values():
+        for cls in mod.classes.values():
+            # first declaration wins on a (rare) cross-module name clash
+            _PACKAGE_CLASSES.setdefault(cls.name, cls)
+
+    # per-function walks
+    summaries: dict[str, FunctionSummary] = {}
+    for mod in modules.values():
+        for cls in mod.classes.values():
+            for name, fn in cls.methods.items():
+                qual = f"{cls.qualname}.{name}"
+                s = FunctionSummary(qual)
+                local_types = {
+                    a.arg: ast.unparse(a.annotation).split(".")[-1]
+                    for a in fn.args.args
+                    if a.annotation is not None
+                }
+                _FunctionWalker(mod, cls, fn, findings, s,
+                                local_types).walk()
+                summaries[qual] = s
+        for name, fn in mod.functions.items():
+            qual = f"{mod.short}.{name}"
+            s = FunctionSummary(qual)
+            local_types = {
+                a.arg: ast.unparse(a.annotation).split(".")[-1]
+                for a in fn.args.args
+                if a.annotation is not None
+            }
+            _FunctionWalker(mod, None, fn, findings, s, local_types).walk()
+            summaries[qual] = s
+
+    # transitive lock summaries (fixpoint over the resolved callgraph)
+    def callee_qual(callee: tuple) -> str | None:
+        kind, a, b = callee
+        if kind == "class":
+            return f"{a}.{b}"
+        for m in modules.values():
+            if m.short == a and b in m.functions:
+                return f"{a}.{b}"
+        return None
+
+    transitive: dict[str, set[str]] = {
+        q: set(s.acquires) for q, s in summaries.items()
+    }
+    for _ in range(len(summaries)):
+        changed = False
+        for q, s in summaries.items():
+            for ev in s.calls:
+                cq = callee_qual(ev.callee)
+                if cq is None or cq not in transitive:
+                    continue
+                before = len(transitive[q])
+                transitive[q] |= transitive[cq]
+                changed = changed or len(transitive[q]) != before
+        if not changed:
+            break
+
+    # the order graph: direct nesting edges + held-across-call edges
+    graph = LockGraph()
+    for q, s in summaries.items():
+        path = _summary_path(q, modules)
+        for (a, b, node) in s.edges:
+            graph.add(a, b, path, node.lineno)
+        for ev in s.calls:
+            if not ev.held:
+                continue
+            cq = callee_qual(ev.callee)
+            if cq is None:
+                continue
+            for b in transitive.get(cq, ()):
+                for a in ev.held:
+                    if a != b:
+                        graph.add(a, b, path, ev.node.lineno)
+
+    # RC001: cycles
+    for cyc in graph.cycles():
+        pairs = list(zip(cyc, cyc[1:]))
+        sites = []
+        for (a, b) in pairs:
+            p, ln = graph.edges.get((a, b), ("?", 0))
+            sites.append(f"{a} -> {b} at {Path(p).name}:{ln}")
+        p0, ln0 = graph.edges.get(pairs[0], ("?", 1))
+        findings.append(Finding(
+            p0, ln0, 0, "RC001", ERROR,
+            "lock-order cycle (potential deadlock): "
+            + "; ".join(sites)
+            + " -- impose one global order on these locks",
+        ))
+
+    # inline suppression for RC001 is by the edge's line, like the rest
+    kept = []
+    for f in findings:
+        mod = modules.get(f.file)
+        if mod is not None:
+            rules = mod.disabled.get(f.line, "missing")
+            if rules is None or (rules != "missing" and f.rule in rules):
+                continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return RacecheckResult(kept, graph, modules)
+
+
+def _summary_path(qual: str, modules: dict) -> str:
+    short = qual.split(".")[0]
+    for mod in modules.values():
+        if mod.short == short:
+            return mod.path
+    return qual
+
+
+# -- driver / CLI ------------------------------------------------------------
+
+
+def check_paths(paths: list[str], baseline_path: Path | None = None):
+    """(live findings, baselined findings, stale entries, graph)."""
+    entries = load_baseline(baseline_path)
+    by_key = {
+        _baseline_key(e["file"], e["rule"], e["line"]): e for e in entries
+    }
+    result = analyze_paths(paths)
+    live, baselined = [], []
+    matched: set[tuple] = set()
+    for f in result.findings:
+        key = _baseline_key(f.file, f.rule, f.line)
+        if key in by_key:
+            matched.add(key)
+            baselined.append(f)
+        else:
+            live.append(f)
+    stale = [e for k, e in by_key.items() if k not in matched]
+    return live, baselined, stale, result.graph
+
+
+def _find_default_baseline(paths: list[str]) -> Path | None:
+    candidates = [Path.cwd()] + [Path(p).resolve() for p in paths]
+    for base in candidates:
+        for directory in [base] + list(base.parents):
+            f = directory / BASELINE_NAME
+            if f.exists():
+                return f
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rdp-racecheck",
+        description="Static concurrency analysis (lock order, guarded_by,"
+                    " blocking-under-lock)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["robotic_discovery_platform_tpu"],
+    )
+    parser.add_argument("--baseline", type=Path, default=None)
+    parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument("--write-baseline", type=Path, metavar="PATH")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--graph", action="store_true",
+                        help="print the lock-order edge list and exit")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RC_RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    baseline = None if args.no_baseline else (
+        args.baseline or _find_default_baseline(args.paths)
+    )
+    if args.graph:
+        result = analyze_paths(args.paths)
+        for (a, b), (path, line) in sorted(result.graph.edges.items()):
+            print(f"{a} -> {b}   ({Path(path).name}:{line})")
+        return 0
+    live, baselined, stale, _graph = check_paths(
+        args.paths, baseline_path=baseline
+    )
+
+    if args.write_baseline:
+        entries = [
+            {"file": f.file.replace("\\", "/").lstrip("./"),
+             "rule": f.rule, "line": f.line, "severity": f.severity,
+             "message": f.message, "justification": ""}
+            for f in live
+        ]
+        args.write_baseline.write_text(json.dumps(
+            {"version": 1, "entries": entries}, indent=2) + "\n")
+        print(f"wrote {len(live)} entries to {args.write_baseline}; "
+              "fill in every justification")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in live],
+            "baselined": [vars(f) for f in baselined],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in live:
+            print(f.render())
+        for e in stale:
+            print(f"{e['file']}:{e['line']}: {e['rule']} [stale-baseline] "
+                  "entry matches no finding; remove it")
+        if baselined:
+            print(f"({len(baselined)} finding(s) suppressed by baseline "
+                  f"{baseline})")
+    failing = [f for f in live if f.severity == ERROR]
+    if failing:
+        print(f"racecheck: {len(failing)} failing finding(s)",
+              file=sys.stderr)
+        return 1
+    if stale:
+        print(f"racecheck: {len(stale)} stale baseline entry(ies)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,24 @@ JL010     error     Pallas VMEM budget: the double-buffered, lane-padded
                     exceeds the scoped-VMEM budget the conv kernels
                     enforce analytically (ops/pallas/conv.vmem_bytes_3x3
                     and its _VMEM_BUDGET)
+JL011     warning   implicit-transfer-prone call inside jitted code on a
+                    value the taint pass CANNOT prove traced:
+                    ``np.asarray``/``np.array``/``float()``/``int()``/
+                    ``.item()``/``.tolist()`` -- JL001's blind spot; if
+                    the value turns out traced at runtime this is a
+                    silent H2D/D2H (run under RDP_TRANSFER_GUARD=strict
+                    to prove it either way)
+JL012     error     a ``threading.Thread`` started without a registered
+                    join/stop owner (``threading.Thread(...).start()``
+                    with the Thread object never bound to a name or
+                    attribute): nothing can join it, the thread-leak
+                    fixture cannot attribute it, shutdown cannot wait
+                    for it
+JL013     error     a lock/semaphore/condition attribute created outside
+                    ``__init__``: re-binding a lock attribute mid-life
+                    splits its waiters across two objects (threads
+                    holding the OLD lock no longer exclude threads
+                    acquiring the NEW one)
 ========  ========  =====================================================
 
 "Jitted code" is computed statically: functions decorated with
@@ -70,6 +88,9 @@ RULES = {
     "JL008": "Pallas grid/BlockSpec shape mismatch",
     "JL009": "out-of-tile Pallas load/store index",
     "JL010": "Pallas blocks exceed the VMEM budget",
+    "JL011": "possibly-implicit transfer inside jitted code",
+    "JL012": "thread started without a join/stop owner",
+    "JL013": "lock attribute created outside __init__",
 }
 
 _JIT_WRAPPERS = {
@@ -360,6 +381,37 @@ def _check_jit_body(
                         "jit happens once at trace time and never again",
                     )
 
+    # JL011: the transfer-prone calls JL001's taint pass could NOT prove
+    # traced. JL001 (error) covers the provable case above; these are its
+    # blind spot -- a value traced through a path the one-pass taint does
+    # not follow turns the same call into a silent implicit transfer.
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        name = aliases.canonical(node.func) or ""
+        arg = node.args[0] if node.args else None
+        prone = None
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "item", "tolist",
+        ):
+            if not taint.is_traced(node.func.value):
+                prone = f".{node.func.attr}()"
+        elif name in ("float", "int") and len(node.args) == 1:
+            if (not isinstance(arg, ast.Constant)
+                    and not taint.is_traced(arg)):
+                prone = f"{name}()"
+        elif name in ("numpy.asarray", "numpy.array") and node.args:
+            if not taint.is_traced(arg):
+                prone = name.replace("numpy", "np") + "()"
+        if prone is not None:
+            finding(
+                node, "JL011", WARNING,
+                f"{prone} inside jitted code on a value the linter cannot "
+                "prove host-side: if it is traced this is an implicit "
+                "H2D/D2H transfer (prove it either way under "
+                "RDP_TRANSFER_GUARD=strict, or use jnp to stay on device)",
+            )
+
 
 def _static_param_findings(
     tree: ast.Module, aliases: _Aliases, out: list[Finding], path: str
@@ -487,6 +539,91 @@ def _module_level_findings(
                         "(and compile) per iteration; hoist the jit out of "
                         "the loop",
                     ))
+
+
+# -- concurrency rules (JL012-JL013) ----------------------------------------
+
+_LOCKLIKE_CTORS = (
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+)
+_INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+
+
+def _is_locklike_ctor(aliases: _Aliases, value: ast.AST) -> bool:
+    """Is this expression a lock(-list) construction? Covers the bare
+    constructors, ``lockcheck.checked_lock``, and list/listcomp wrappers
+    (per-chip semaphore rings)."""
+    if isinstance(value, ast.Call):
+        name = aliases.canonical(value.func) or ""
+        return name in _LOCKLIKE_CTORS or name.endswith("checked_lock")
+    if isinstance(value, ast.List):
+        return any(_is_locklike_ctor(aliases, e) for e in value.elts)
+    if isinstance(value, ast.ListComp):
+        return _is_locklike_ctor(aliases, value.elt)
+    return False
+
+
+def _concurrency_findings(
+    tree: ast.Module, aliases: _Aliases, out: list[Finding], path: str
+) -> None:
+    # JL012: threading.Thread(...) whose object is never bound -- the
+    # literal evidence is a Thread construction used as a bare expression
+    # or immediately chained into .start() without a binding.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Expr):
+            continue
+        call = node.value
+        thread_ctor = None
+        if isinstance(call, ast.Call):
+            f = call.func
+            if (isinstance(f, ast.Attribute) and f.attr == "start"
+                    and isinstance(f.value, ast.Call)
+                    and aliases.canonical(f.value.func)
+                    == "threading.Thread"):
+                thread_ctor = f.value
+            elif aliases.canonical(call.func) == "threading.Thread":
+                thread_ctor = call
+        if thread_ctor is not None:
+            out.append(Finding(
+                path, node.lineno, node.col_offset, "JL012", ERROR,
+                "thread started without a registered join/stop owner: the "
+                "Thread object is never bound, so nothing can join it at "
+                "shutdown and a leak cannot be attributed -- bind it to "
+                "an attribute (and join/stop it) or justify the "
+                "fire-and-forget",
+            ))
+
+    # JL013: lock attribute (re)created outside __init__ -- waiters split
+    # across the old and new object
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                continue
+            if method.name in _INIT_METHODS:
+                continue
+            for node in ast.walk(method):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _is_locklike_ctor(aliases, node.value):
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        out.append(Finding(
+                            path, node.lineno, node.col_offset, "JL013",
+                            ERROR,
+                            f"lock attribute self.{t.attr} re-created in "
+                            f"{method.name!r} (outside __init__): threads "
+                            "holding the old lock object no longer "
+                            "exclude threads acquiring the new one -- if "
+                            "the re-bind is a deliberate epoch reset, "
+                            "say so with an inline disable",
+                        ))
 
 
 # -- Pallas kernel-body rules (JL008-JL010) ---------------------------------
@@ -729,5 +866,6 @@ def check_module(tree: ast.Module, path: str) -> list[Finding]:
         _check_jit_body(root, aliases, out, path)
     _static_param_findings(tree, aliases, out, path)
     _module_level_findings(tree, aliases, out, path)
+    _concurrency_findings(tree, aliases, out, path)
     _pallas_findings(tree, aliases, out, path)
     return out

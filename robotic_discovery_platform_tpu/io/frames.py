@@ -26,6 +26,7 @@ from typing import Iterator, Protocol
 import numpy as np
 
 from robotic_discovery_platform_tpu.resilience import RetryPolicy
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -157,8 +158,8 @@ class RealSenseSource:
         self._config.enable_stream(rs.stream.color, width, height, rs.format.bgr8, fps)
         self._align = None
         self._depth_scale = 0.001
-        self._latest: tuple[np.ndarray, np.ndarray] | None = None
-        self._lock = threading.Lock()
+        self._latest: tuple[np.ndarray, np.ndarray] | None = None  # guarded_by: _lock
+        self._lock = checked_lock("frames.realsense")
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
 

@@ -43,7 +43,6 @@ from __future__ import annotations
 import json
 import math
 import os
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -51,6 +50,7 @@ from pathlib import Path
 from typing import Callable, Mapping, NamedTuple, Sequence
 
 from robotic_discovery_platform_tpu.observability.sketch import StreamingSketch
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -426,18 +426,18 @@ class DriftMonitor:
         self._on_score = on_score
         self._on_recommendation = on_recommendation
         self._clock = clock
-        self._lock = threading.Lock()
-        self._windows: dict[str, deque[float]] = {
+        self._lock = checked_lock("drift.monitor")
+        self._windows: dict[str, deque[float]] = {  # guarded_by: _lock
             name: deque(maxlen=self.window) for name in self.spec
         }
-        self._reference: FeatureProfile | None = None
-        self._baseline: FeatureProfile | None = None
-        self._frames = 0
-        self._scores: dict[str, DriftScore] = {}
-        self._above_since: dict[str, float] = {}
-        self._armed = True
-        self._last_fire: float | None = None
-        self._fired_total = 0
+        self._reference: FeatureProfile | None = None  # guarded_by: _lock
+        self._baseline: FeatureProfile | None = None  # guarded_by: _lock
+        self._frames = 0  # guarded_by: _lock
+        self._scores: dict[str, DriftScore] = {}  # guarded_by: _lock
+        self._above_since: dict[str, float] = {}  # guarded_by: _lock
+        self._armed = True  # guarded_by: _lock
+        self._last_fire: float | None = None  # guarded_by: _lock
+        self._fired_total = 0  # guarded_by: _lock
         self.recommendations: list[RetrainRecommendation] = []
         if reference is not None:
             self.set_reference(reference)

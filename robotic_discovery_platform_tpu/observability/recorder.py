@@ -29,12 +29,12 @@ from __future__ import annotations
 
 import itertools
 import os
-import threading
 import time
 from collections import deque
 from typing import Iterable
 
 from robotic_discovery_platform_tpu.observability.trace import SpanRecord
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 
 #: tracez-style latency buckets (ms) for the /debug/tracez summary
 TRACEZ_BOUNDS_MS: tuple[float, ...] = (1.0, 10.0, 100.0, 1000.0)
@@ -114,8 +114,8 @@ class FlightRecorder:
         self._capacity = max(1, int(capacity))
         self._ring: list[Timeline | None] = [None] * self._capacity
         self._seq = itertools.count()
-        self._pinned: deque[Timeline] = deque(maxlen=max(1, pin_capacity))
-        self._pin_lock = threading.Lock()
+        self._pinned: deque[Timeline] = deque(maxlen=max(1, pin_capacity))  # guarded_by: _pin_lock
+        self._pin_lock = checked_lock("recorder.pin")
 
     @property
     def capacity(self) -> int:

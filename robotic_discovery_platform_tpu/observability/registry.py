@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Callable, Iterator, NamedTuple, Sequence
 
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
+
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
@@ -80,8 +82,11 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = _validate_labelnames(labelnames)
-        self._lock = threading.Lock()
-        self._children: dict[tuple[str, ...], object] = {}
+        # one lock per family, shared with its children (value mutations
+        # and the child map agree on one owner); named per family so the
+        # RDP_LOCKCHECK order graph can tell metric locks apart
+        self._lock = checked_lock(f"metrics.{name}")
+        self._children: dict[tuple[str, ...], object] = {}  # guarded_by: _lock
         if not self.labelnames:
             # the unlabeled singleton child, so `metric.inc()` works
             self._children[()] = self._make_child(())
@@ -124,7 +129,7 @@ class _Metric:
 class _CounterChild:
     def __init__(self, lock: threading.Lock):
         self._lock = lock
-        self._value = 0.0
+        self._value = 0.0  # guarded_by: _lock
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
@@ -160,7 +165,7 @@ class Counter(_Metric):
 class _GaugeChild:
     def __init__(self, lock: threading.Lock):
         self._lock = lock
-        self._value = 0.0
+        self._value = 0.0  # guarded_by: _lock
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -209,9 +214,10 @@ class _HistogramChild:
     def __init__(self, lock: threading.Lock, buckets: tuple[float, ...]):
         self._lock = lock
         self._buckets = buckets
-        self._counts = [0] * (len(buckets) + 1)  # last slot: > max bucket
-        self._sum = 0.0
-        self._count = 0
+        # last slot: > max bucket
+        self._counts = [0] * (len(buckets) + 1)  # guarded_by: _lock
+        self._sum = 0.0  # guarded_by: _lock
+        self._count = 0  # guarded_by: _lock
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -397,8 +403,8 @@ class _SummaryChild:
     def __init__(self, lock: threading.Lock, quantiles: tuple[float, ...]):
         self._lock = lock
         self._est = {q: P2Quantile(q) for q in quantiles}
-        self._sum = 0.0
-        self._count = 0
+        self._sum = 0.0  # guarded_by: _lock
+        self._count = 0  # guarded_by: _lock
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -509,8 +515,8 @@ class MetricsRegistry:
     """Thread-safe name -> metric map with get-or-create semantics."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._metrics: dict[str, _Metric] = {}
+        self._lock = checked_lock("metrics.registry")
+        self._metrics: dict[str, _Metric] = {}  # guarded_by: _lock
 
     def _get_or_create(self, cls, name: str, help: str,
                        labelnames: Sequence[str], factory: Callable):

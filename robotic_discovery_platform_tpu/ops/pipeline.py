@@ -24,6 +24,7 @@ import numpy as np
 from robotic_discovery_platform_tpu.analysis import recompile
 from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 from robotic_discovery_platform_tpu.ops import geometry
+from robotic_discovery_platform_tpu.utils import transferguard
 from robotic_discovery_platform_tpu.utils.config import GeometryConfig
 
 
@@ -225,7 +226,10 @@ def make_frame_analyzer(
         )
         return jax.tree.map(lambda a: a[0], out)
 
-    return analyze
+    # RDP_TRANSFER_GUARD: with the guard armed, every warm call must move
+    # zero implicit bytes (explicit stage_batch/device_put staging only);
+    # off (default) this returns `analyze` unchanged
+    return transferguard.apply(analyze)
 
 
 def make_batch_analyzer(
@@ -263,7 +267,7 @@ def make_batch_analyzer(
             img_size, geom_cfg, threshold, forward,
         )
 
-    return analyze
+    return transferguard.apply(analyze)
 
 
 def make_scan_batch_analyzer(
@@ -307,4 +311,4 @@ def make_scan_batch_analyzer(
         _, outs = jax.lax.scan(step, 0, (frames_rgb, depths, intr, scales))
         return outs  # every leaf stacked to leading B by scan
 
-    return analyze
+    return transferguard.apply(analyze)

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from robotic_discovery_platform_tpu.analysis import recompile
 from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+from robotic_discovery_platform_tpu.utils import transferguard
 
 # shard_map API compat: jax >= 0.5 exposes jax.shard_map with replication
 # checking named check_vma; 0.4.x has jax.experimental.shard_map.shard_map
@@ -140,21 +141,21 @@ def parallelize_training(
 
     sharded_state = jax.tree.map(jax.device_put, state, state_shardings)
 
-    train = jax.jit(
+    train = transferguard.apply(jax.jit(
         recompile.trace_guard("parallel.train_step", budget=3)(
             core_train_step(model, tx, loss_fn)
         ),
         in_shardings=(state_shardings, batch_sh, batch_sh),
         out_shardings=(state_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,) if donate else (),
-    )
-    evals = jax.jit(
+    ))
+    evals = transferguard.apply(jax.jit(
         recompile.trace_guard("parallel.eval_step", budget=3)(
             core_eval_step(model, loss_fn)
         ),
         in_shardings=(state_shardings, batch_sh, batch_sh),
         out_shardings=NamedSharding(mesh, P()),
-    )
+    ))
     return train, evals, sharded_state
 
 
@@ -205,9 +206,9 @@ def shard_map_train_step(mesh: Mesh, model, tx, loss_fn: Callable,
         )
         return mapped(state, x, y)
 
-    return jax.jit(
+    return transferguard.apply(jax.jit(
         recompile.trace_guard("parallel.shard_map_train_step", budget=3)(
             step
         ),
         donate_argnums=(0,) if donate else (),
-    )
+    ))

@@ -15,10 +15,10 @@ warning per poll tick.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Callable
 
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -76,12 +76,12 @@ class CircuitBreaker:
         self.failure_threshold = failure_threshold
         self.reset_timeout_s = reset_timeout_s
         self._clock = clock
-        self._lock = threading.Lock()
-        self._state = CLOSED
-        self._failures = 0
-        self._opened_at = 0.0
-        self._probe_in_flight = False
-        self._last_error: BaseException | None = None
+        self._lock = checked_lock(f"breaker.{name}")
+        self._state = CLOSED  # guarded_by: _lock
+        self._failures = 0  # guarded_by: _lock
+        self._opened_at = 0.0  # guarded_by: _lock
+        self._probe_in_flight = False  # guarded_by: _lock
+        self._last_error: BaseException | None = None  # guarded_by: _lock
         _notify(self.name, None, self._state)
 
     # -- state --------------------------------------------------------------
@@ -102,8 +102,7 @@ class CircuitBreaker:
         with self._lock:
             return self._last_error
 
-    def _maybe_half_open(self) -> None:
-        # caller holds the lock
+    def _maybe_half_open(self) -> None:  # guarded_by: _lock
         if (self._state == OPEN
                 and self._clock() - self._opened_at >= self.reset_timeout_s):
             self._state = HALF_OPEN
@@ -155,8 +154,7 @@ class CircuitBreaker:
                     and self._failures >= self.failure_threshold):
                 self._trip(f"{self._failures} consecutive failures", exc)
 
-    def _trip(self, why: str, exc: BaseException | None) -> None:
-        # caller holds the lock
+    def _trip(self, why: str, exc: BaseException | None) -> None:  # guarded_by: _lock
         old = self._state
         self._state = OPEN
         self._opened_at = self._clock()

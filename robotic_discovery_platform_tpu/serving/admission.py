@@ -41,6 +41,8 @@ import time
 from collections import deque
 from typing import Any, Callable
 
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
+
 POLICIES = ("deadline", "fifo")
 
 
@@ -62,9 +64,9 @@ class ServiceTimeEstimator:
     admission never sheds on a guess it has not earned."""
 
     def __init__(self, window: int = 16):
-        self._lock = threading.Lock()
-        self._window: deque[float] = deque(maxlen=max(1, int(window)))
-        self._n = 0
+        self._lock = checked_lock("admission.estimator")
+        self._window: deque[float] = deque(maxlen=max(1, int(window)))  # guarded_by: _lock
+        self._n = 0  # guarded_by: _lock
 
     def observe(self, seconds: float) -> None:
         if seconds < 0:
@@ -120,10 +122,15 @@ class DeadlineQueue:
         self.policy = policy
         self._on_evict = on_evict
         self._clock = clock
-        self._items: deque[Any] = deque()
+        # a Condition, not a checked_lock: waiters need wait/notify, and
+        # the sanitizer wrapper deliberately does not impersonate the
+        # Condition protocol (its _is_owned fallback probes with a
+        # non-blocking acquire, which the re-acquisition check would
+        # rightly reject)
         self._cond = threading.Condition()
+        self._items: deque[Any] = deque()  # guarded_by: _cond
         #: items shed by least-headroom eviction since construction
-        self.evictions = 0
+        self.evictions = 0  # guarded_by: _cond
 
     def qsize(self) -> int:
         with self._cond:

@@ -160,6 +160,7 @@ from robotic_discovery_platform_tpu.serving.admission import (
     OverloadedError,
     ServiceTimeEstimator,
 )
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -288,11 +289,11 @@ class DeviceRouter:
             and len(self.ring) > 1
         )
         self.on_health = on_health
-        self._qlock = threading.Lock()
-        self._quarantined: set[int] = set()
+        self._qlock = checked_lock("batching.router.quarantine")
+        self._quarantined: set[int] = set()  # guarded_by: _qlock
         #: chips quarantined since construction (monotone; the gauge is
         #: the live set size)
-        self.quarantines_total = 0
+        self.quarantines_total = 0  # guarded_by: _qlock
         self.breakers: list[CircuitBreaker] = []
         if self.quarantine_enabled:
             self.breakers = [
@@ -565,8 +566,10 @@ class BatchDispatcher:
         # stale sheds with no completed dispatch in between, the next
         # frame is admitted regardless, so a stale estimate (or a pile
         # of doomed frames) can never starve the signal that refreshes
-        # the estimate
-        self._sheds_since_complete = 0
+        # the estimate. Collector increments, completer resets: two
+        # threads, so the counter rides the inflight lock (racecheck
+        # RC002 surfaced the bare read-modify-write here).
+        self._sheds_since_complete = 0  # guarded_by: _inflight_lock
         #: multiplier on the service estimate when deciding a deadline is
         #: unmeetable; the controller's brownout ladder raises it to shed
         #: earlier at admission (level 2), 1.0 = only shed truly doomed
@@ -605,19 +608,20 @@ class BatchDispatcher:
             threading.Semaphore(self._max_inflight)
             for _ in range(self._n_windows)
         ]
-        self._inflight_lock = threading.Lock()
-        self._inflight_count = 0
-        self._chip_inflight = [0] * self._n_windows
-        self._rr_next = 0  # least-loaded tie-break cursor (ring order)
+        self._inflight_lock = checked_lock("batching.inflight")
+        self._inflight_count = 0  # guarded_by: _inflight_lock
+        self._chip_inflight = [0] * self._n_windows  # guarded_by: _inflight_lock
+        # least-loaded tie-break cursor (ring order)
+        self._rr_next = 0  # guarded_by: _inflight_lock
         #: per-chip launched-dispatch / carried-frame totals (padding rows
         #: excluded); the bench derives per-chip FPS and balance from these
-        self.chip_dispatches = [0] * self._n_windows
-        self.chip_frames = [0] * self._n_windows
-        self.chip_inflight_high_water = [0] * self._n_windows
+        self.chip_dispatches = [0] * self._n_windows  # guarded_by: _inflight_lock
+        self.chip_frames = [0] * self._n_windows  # guarded_by: _inflight_lock
+        self.chip_inflight_high_water = [0] * self._n_windows  # guarded_by: _inflight_lock
         #: high-water mark of concurrently in-flight dispatches; never
         #: exceeds ``max_inflight`` per window (tests and the bench assert
         #: on this)
-        self.inflight_high_water = 0
+        self.inflight_high_water = 0  # guarded_by: _inflight_lock
         #: total seconds completed dispatches overlapped the next launch
         #: (0.0 in serial mode); written only by the completer thread
         self.overlap_s_total = 0.0
@@ -627,18 +631,18 @@ class BatchDispatcher:
         # Capped per key at one buffer set per possible in-flight dispatch
         # plus the one being staged: anything beyond that is a leak, so
         # _pool_put drops extras instead of growing without bound.
-        self._pool: dict[tuple, list[_BucketBuffers]] = {}
+        self._pool: dict[tuple, list[_BucketBuffers]] = {}  # guarded_by: _pool_lock
         self._pool_cap = self._max_inflight * self._n_windows + 1
-        self._pool_lock = threading.Lock()
+        self._pool_lock = checked_lock("batching.pool")
         obs.SERVING_CHIPS.set(router.chips if router is not None else 1)
         self._stopped = threading.Event()
-        self._submit_lock = threading.Lock()
+        self._submit_lock = checked_lock("batching.submit")
         # every not-yet-completed submit, whether still queued, staged, or
         # in flight on the device: the watchdog error-completes exactly
         # this set when a pipeline stage dies, so a frame caught between
         # queues is covered too
-        self._pending: set[_Pending] = set()
-        self._pending_lock = threading.Lock()
+        self._pending: set[_Pending] = set()  # guarded_by: _pending_lock
+        self._pending_lock = checked_lock("batching.pending")
         self.collector_restarts = 0
         self.completer_restarts = 0
         self._completer = self._start_completer()
@@ -805,7 +809,10 @@ class BatchDispatcher:
             old = self._max_inflight
             self._max_inflight = n
             self._pool_cap = n * self._n_windows + 1
-            self._chip_slots = [
+            # deliberate epoch reset: in-flight dispatches hold their own
+            # slot objects, so re-binding starts a fresh window rather
+            # than splitting waiters
+            self._chip_slots = [  # jaxlint: disable=JL013
                 threading.Semaphore(n) for _ in range(self._n_windows)
             ]
         log.info("max_inflight retuned: %d -> %d", old, n)
@@ -888,14 +895,16 @@ class BatchDispatcher:
                 # fresh in-flight windows ON EVERY CHIP: slots held by
                 # dispatches lost with the dead stage can never be
                 # released (a dispatch still riding a live completer
-                # releases its OWN slot object, never these new ones)
-                self._chip_slots = [
+                # releases its OWN slot object, never these new ones) --
+                # the same deliberate epoch reset as set_max_inflight
+                self._chip_slots = [  # jaxlint: disable=JL013
                     threading.Semaphore(self._max_inflight)
                     for _ in range(self._n_windows)
                 ]
                 with self._inflight_lock:
                     self._inflight_count = 0
                     self._chip_inflight = [0] * self._n_windows
+                    self._sheds_since_complete = 0
                     obs.INFLIGHT_DISPATCHES.set(0)
                     for chip in range(self._n_windows):
                         obs.CHIP_INFLIGHT.labels(chip=str(chip)).set(0)
@@ -925,12 +934,13 @@ class BatchDispatcher:
             est = self.service_estimate.s * self.deadline_safety
             slack = p.deadline_t - time.monotonic()
             if est > 0 and slack < est:
-                if self._sheds_since_complete >= 8:
-                    # probe-through: admit this frame despite the verdict
-                    # so its ride refreshes the service estimate (the
-                    # completer resets the counter)
-                    return True
-                self._sheds_since_complete += 1
+                with self._inflight_lock:
+                    if self._sheds_since_complete >= 8:
+                        # probe-through: admit this frame despite the
+                        # verdict so its ride refreshes the service
+                        # estimate (the completer resets the counter)
+                        return True
+                    self._sheds_since_complete += 1
                 obs.SHED_BY_DEADLINE.labels(point="stale").inc()
                 self._fail_group([p], DeadlineExceeded(
                     f"deadline unmeetable: ~{est * 1e3:.0f}ms estimated "
@@ -1296,7 +1306,8 @@ class BatchDispatcher:
                     self.service_estimate.observe(
                         time.monotonic() - d.staged_t
                     )
-                self._sheds_since_complete = 0
+                with self._inflight_lock:
+                    self._sheds_since_complete = 0
                 if self._router is not None and d.mode == "round_robin":
                     # a completed dispatch is the chip's success signal --
                     # and a quarantined chip's successful PROBE, which

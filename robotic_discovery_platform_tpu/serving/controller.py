@@ -51,6 +51,16 @@ production. The controller only ever touches host-side scheduling knobs
 (it holds no device state), so enabled-but-idle it changes nothing:
 serial depth-1 parity stays bitwise.
 
+Concurrency discipline (rdp-racecheck): the controller holds NO locks of
+its own -- every mutable field (``level``, the hysteresis timers, the
+captured base knobs) is written exclusively by the tick thread
+(single-writer; ``tick()`` is also what tests call directly, never
+concurrently with ``start()``), and every actuation goes through the
+dispatcher's ``set_*`` mutators, which take the dispatcher's own locks.
+That keeps the controller out of the lock-order graph entirely: it can
+never deadlock against the collector/completer/watchdog, only call into
+them.
+
 ``ServerConfig.controller_enabled`` / ``RDP_CONTROLLER`` turn it on;
 serving/server.py wires the live signals (SLO tracker burn, dispatcher
 backlog) and actuators (the dispatcher's ``set_*`` surface plus the

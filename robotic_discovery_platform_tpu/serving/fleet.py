@@ -60,6 +60,7 @@ from robotic_discovery_platform_tpu.serving.proto import (
     health_pb2,
     vision_grpc,
 )
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -320,15 +321,15 @@ class FleetRouter:
         self.probe_timeout_s = probe_timeout_s
         self.controller = controller
         self.on_membership = on_membership
-        self._lock = threading.Lock()
-        self._ring_start = 0
-        self._last_live = -1
+        self._lock = checked_lock("fleet.router")
+        self._ring_start = 0  # guarded_by: _lock
+        self._last_live = -1  # guarded_by: _lock
         self._stop: threading.Event | None = None
         self._thread: threading.Thread | None = None
         #: stream-level failovers observed (reroutes + error-completions)
-        self.failovers_total = 0
-        self.failover_frames_rerouted = 0
-        self.failover_frames_error_completed = 0
+        self.failovers_total = 0  # guarded_by: _lock
+        self.failover_frames_rerouted = 0  # guarded_by: _lock
+        self.failover_frames_error_completed = 0  # guarded_by: _lock
 
     # -- membership ----------------------------------------------------------
 
@@ -395,13 +396,22 @@ class FleetRouter:
         live = self.live_count
         obs.FLEET_REPLICAS_LIVE.set(live)
         obs.FLEET_REPLICAS_QUARANTINED.set(self.quarantined_count)
-        if live != self._last_live:
-            self._last_live = live
-            if self.on_membership is not None:
-                try:
-                    self.on_membership(live)
-                except Exception:  # pragma: no cover - observer bug
-                    log.exception("fleet membership callback failed")
+        # the change test runs under the lock: _publish_membership is
+        # reached from the poll thread AND from stream handlers
+        # (on_stream_error), and an unguarded read-modify-write here can
+        # double-fire or swallow a membership transition. The callback
+        # runs OUTSIDE the lock -- it flips gRPC health (its own
+        # condition), and holding the router lock across it would nest
+        # foreign locks for no reason.
+        with self._lock:
+            changed = live != self._last_live
+            if changed:
+                self._last_live = live
+        if changed and self.on_membership is not None:
+            try:
+                self.on_membership(live)
+            except Exception:  # pragma: no cover - observer bug
+                log.exception("fleet membership callback failed")
         return live
 
     @property
@@ -458,6 +468,15 @@ class FleetRouter:
             replica.inflight = max(0, replica.inflight - 1)
         obs.FLEET_REPLICA_STREAMS.labels(replica=replica.endpoint).set(
             replica.inflight)
+
+    def count_frame(self, replica: Replica) -> None:
+        """One frame relayed through ``replica``. Counted under the
+        router lock: concurrent streams share a replica, and the bare
+        ``replica.frames += 1`` this replaces dropped increments under
+        load (the racecheck RC002 class of bug, cross-object)."""
+        with self._lock:
+            replica.frames += 1
+        obs.FLEET_REPLICA_FRAMES.labels(replica=replica.endpoint).inc()
 
     def on_stream_ok(self, replica: Replica) -> None:
         """A relayed stream completed cleanly: clears the breaker's

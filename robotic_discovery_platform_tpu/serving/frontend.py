@@ -41,7 +41,6 @@ from __future__ import annotations
 
 import queue
 import threading
-import time
 from collections import deque
 from concurrent import futures
 
@@ -49,7 +48,6 @@ import grpc
 
 from robotic_discovery_platform_tpu.observability import (
     exposition,
-    instruments as obs,
     trace,
 )
 from robotic_discovery_platform_tpu.serving import (
@@ -61,6 +59,7 @@ from robotic_discovery_platform_tpu.serving.proto import (
     vision_pb2,
 )
 from robotic_discovery_platform_tpu.utils.config import ServerConfig
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -82,15 +81,15 @@ class _StreamState:
                  "closed", "gen", "pump_error")
 
     def __init__(self, inbox_depth: int = 64):
-        self.lock = threading.Lock()
+        self.lock = checked_lock("frontend.stream")
         # bounded: a slow replica backpressures the pump thread, and gRPC
         # flow control pushes that back to the client
         self.inbox: queue.Queue = queue.Queue(maxsize=inbox_depth)
         #: sent to the current replica, response not yet relayed
-        self.pending: deque = deque()
+        self.pending: deque = deque()  # guarded_by: lock
         #: pulled from the inbox by a retired feeder after its attempt
         #: died; the next attempt's feeder drains this first
-        self.stash: deque = deque()
+        self.stash: deque = deque()  # guarded_by: lock
         self.client_done = False
         self.closed = False
         #: failover generation; a feeder retires when it no longer matches
@@ -225,9 +224,9 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
                         with st.lock:
                             if st.pending:
                                 st.pending.popleft()
-                        replica.frames += 1
-                        obs.FLEET_REPLICA_FRAMES.labels(
-                            replica=replica.endpoint).inc()
+                        # under the router lock: concurrent streams share
+                        # this replica, and a bare += here drops counts
+                        router.count_frame(replica)
                         yield resp
                     # replica closed the stream cleanly (our feeder ended
                     # after the client finished). A deadline-expired

@@ -289,9 +289,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         )
         # brownout rung 3: the controller flips this and _enter_stream
         # refuses every other new stream (UNAVAILABLE -> clients fail
-        # over; the duty cycle keeps the SLO signal alive)
-        self._refusing_streams = False
-        self._brownout_tick = 0
+        # over; the duty cycle keeps the SLO signal alive). Both ride
+        # the stream condition: the writer is the controller thread, the
+        # readers are every handler thread.
+        self._refusing_streams = False  # guarded_by: _streams_cond
+        self._brownout_tick = 0  # guarded_by: _streams_cond
         self._engine = self._make_engine(model, variables, version)
         self._warm_shape: tuple[int, int] | None = None
         self._reload_stop: threading.Event | None = None
@@ -328,13 +330,15 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         obs.SERVING_CHIPS.set(self.serving_chips)
         # in-flight stream accounting for graceful drain
         self._streams_cond = threading.Condition()
-        self._active_streams = 0
-        self._draining = False
+        self._active_streams = 0  # guarded_by: _streams_cond
+        self._draining = False  # guarded_by: _streams_cond
         # frames served over this process's lifetime (every terminal
         # status); reported over the replica stats RPC so a fleet
         # front-end can read per-replica progress without scraping
-        # /metrics over HTTP
-        self._frames_total = 0
+        # /metrics over HTTP. Incremented by every handler thread, so it
+        # rides the stream condition too (the bare += it replaces lost
+        # counts under concurrent streams).
+        self._frames_total = 0  # guarded_by: _streams_cond
         self.metrics = metrics or MetricsWriter(
             cfg.metrics_csv, cfg.metrics_flush_every
         )
@@ -409,12 +413,14 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
     def _set_refuse_streams(self, refusing: bool) -> None:
         """Controller brownout rung 3 actuator."""
-        if refusing != self._refusing_streams:
+        with self._streams_cond:
+            changed = refusing != self._refusing_streams
+            self._refusing_streams = refusing
+        if changed:
             log.warning(
                 "overload brownout: %s new analysis streams",
                 "refusing" if refusing else "accepting",
             )
-        self._refusing_streams = refusing
 
     def _on_chip_health(self, chip: int, serving: bool) -> None:
         """DeviceRouter quarantine hook: a quarantined chip's
@@ -549,6 +555,18 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 100 * qreport["max_rel_err"], qreport["int8_bytes"],
                 qreport["f32_bytes"],
             )
+        # Stage the weight tree explicitly ONCE per engine generation
+        # (already the per-chip policy under a serving mesh): a
+        # checkpoint-restored tree can surface as host numpy, and passing
+        # that to the jitted analyzer re-transfers every weight on every
+        # dispatch -- implicitly, which RDP_TRANSFER_GUARD=strict rightly
+        # refuses. Gated on the tree actually holding host arrays so an
+        # all-device tree keeps OBJECT identity (the f32 tier's
+        # bitwise-identical-by-construction contract is literally "same
+        # objects in, same objects out").
+        if any(not isinstance(leaf, jax.Array)
+               for leaf in jax.tree_util.tree_leaves(variables)):
+            variables = jax.device_put(variables)
         if self._serving_mesh is not None:
             # the Pallas-fused forward closes over default-device buffers
             # and has no partitioning rules, so under a serving mesh every
@@ -713,13 +731,16 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     timeout_s=timeout_s,
                 )
             else:
-                out = eng.analyze(
-                    eng.variables,
-                    rgb,
-                    depth,
-                    np.asarray(k, np.float32),
+                # explicit H2D for the frame inputs: the jitted entry runs
+                # under the transfer guard, and relying on implicit
+                # per-call transfers is exactly the host-path tax the
+                # guard exists to flag (device_put is async -- it does
+                # not block the handler thread)
+                staged = jax.device_put((
+                    rgb, depth, np.asarray(k, np.float32),
                     np.float32(self.depth_scale),
-                )
+                ))
+                out = eng.analyze(eng.variables, *staged)
             # host fetch of the fused result
             mask = np.asarray(out.mask)
             coverage = float(out.mask_coverage)
@@ -917,7 +938,8 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     status_label = "error"
                 total_s = time.perf_counter() - t0
                 response.proc_time_ms = total_s * 1e3
-                self._frames_total += 1
+                with self._streams_cond:
+                    self._frames_total += 1
                 obs.FRAMES.labels(status=status_label).inc()
                 obs.STAGE_LATENCY.labels(stage="total").observe(total_s)
                 obs.STAGE_LATENCY_SUMMARY.labels(stage="total").observe(

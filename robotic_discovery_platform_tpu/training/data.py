@@ -141,7 +141,9 @@ def _prefetched(producer_batches, make_item, prefetch: int):
         finally:
             _put(stop)
 
-    threading.Thread(target=producer, daemon=True).start()
+    worker = threading.Thread(target=producer, name="batch-prefetch",
+                              daemon=True)
+    worker.start()
     try:
         while True:
             item = q.get()
@@ -152,6 +154,11 @@ def _prefetched(producer_batches, make_item, prefetch: int):
             yield item
     finally:
         cancel.set()
+        # the cancel event unblocks a producer stuck on a full queue, so
+        # this join is bounded: the thread (and its queued batches) is
+        # actually released before the consumer moves on, instead of
+        # lingering for the process lifetime
+        worker.join(timeout=5)
 
 
 class Batches:

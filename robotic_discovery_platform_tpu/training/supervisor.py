@@ -208,7 +208,9 @@ def _arm_fault(fault: dict, checkpoint_dir: str) -> None:
                 os._exit(_FAULT_EXIT)
             time.sleep(0.05)
 
-    threading.Thread(target=watch, daemon=True).start()
+    # deliberately unowned: this watcher's whole job is to os._exit the
+    # process -- there is no shutdown path left to join it from
+    threading.Thread(target=watch, daemon=True).start()  # jaxlint: disable=JL012
 
 
 def _child(spec_path: str) -> None:

@@ -36,6 +36,7 @@ from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
 from robotic_discovery_platform_tpu.observability import instruments as obs
 from robotic_discovery_platform_tpu.training import data as data_lib
 from robotic_discovery_platform_tpu.training.checkpoint import CheckpointManager
+from robotic_discovery_platform_tpu.utils import transferguard
 from robotic_discovery_platform_tpu.utils.config import ModelConfig, TrainConfig
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
@@ -101,12 +102,14 @@ def make_train_step(model, tx, loss_fn: Callable, donate: bool = True):
     budget 3 tolerates the legitimate extra shapes (a trailing partial
     batch, a resume with a different batch size) before the guard flags a
     retrace leak."""
-    return jax.jit(
+    # transferguard.apply: under RDP_TRANSFER_GUARD, warm steps may move
+    # no implicit bytes (prefetch_to_device is the sanctioned H2D path)
+    return transferguard.apply(jax.jit(
         recompile.trace_guard("trainer.train_step", budget=3)(
             core_train_step(model, tx, loss_fn)
         ),
         donate_argnums=(0,) if donate else (),
-    )
+    ))
 
 
 def core_eval_step(model, loss_fn: Callable):
@@ -128,11 +131,11 @@ def core_eval_step(model, loss_fn: Callable):
 
 
 def make_eval_step(model, loss_fn: Callable):
-    return jax.jit(
+    return transferguard.apply(jax.jit(
         recompile.trace_guard("trainer.eval_step", budget=3)(
             core_eval_step(model, loss_fn)
         )
-    )
+    ))
 
 
 def make_epoch_runners(model, tx, loss_fn: Callable, donate: bool = True):
@@ -171,14 +174,16 @@ def make_epoch_runners(model, tx, loss_fn: Callable, donate: bool = True):
         return jax.tree.map(jnp.mean, metrics)
 
     return (
-        jax.jit(
+        transferguard.apply(jax.jit(
             recompile.trace_guard("trainer.train_epoch", budget=2)(
                 train_epoch
             ),
             donate_argnums=(0,) if donate else (),
-        ),
-        jax.jit(recompile.trace_guard("trainer.eval_epoch", budget=2)(
-            eval_epoch
+        )),
+        transferguard.apply(jax.jit(
+            recompile.trace_guard("trainer.eval_epoch", budget=2)(
+                eval_epoch
+            )
         )),
     )
 

@@ -1,0 +1,99 @@
+"""Transfer guard: make implicit host<->device transfers on the hot path
+fail loudly.
+
+At 544 device-side FPS the next bottleneck is the host path (ROADMAP
+"the device is now waiting on Python"), and the silent killer there is an
+*implicit* transfer: a numpy array handed straight to a jitted call (H2D
+re-staged per call), or a traced value concretized mid-graph (D2H sync).
+``jax.transfer_guard`` can refuse those at runtime; this module wires it
+around the platform's hot jitted entries (frame/batch/scan analyzers,
+train/eval steps) behind one env knob, the same deployment convention as
+``RDP_RECOMPILE_STRICT`` / ``RDP_LOCKCHECK``:
+
+- ``RDP_TRANSFER_GUARD=strict`` -- implicit transfers inside a guarded
+  call raise (``disallow``); the serving path must stage explicitly
+  (``ops/pipeline.stage_batch`` / ``jax.device_put``), which it does;
+- ``RDP_TRANSFER_GUARD=log`` -- implicit transfers log but proceed
+  (finding the offenders without dropping frames);
+- unset/``off`` -- the wrapper returns the function unchanged: zero
+  overhead, the production default.
+
+**The first call per argument signature is exempt.** A cold call compiles,
+and compilation legitimately transfers trace-time constants (weight trees
+baked into the closure, jit-internal scalars); the discipline the guard
+enforces is that the *steady-state* path -- every call after warm-up --
+moves no implicit bytes. This mirrors the recompile guard's
+"one compile per shape is the declared budget" stance, and means warm-up
+(which serving always runs before readiness flips) both compiles and arms
+the guard.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Callable
+
+_ENV_VAR = "RDP_TRANSFER_GUARD"
+
+MODES = ("off", "log", "strict")
+
+
+def resolve_transfer_guard() -> str:
+    """The effective guard mode: ``RDP_TRANSFER_GUARD`` normalized to
+    ``off``/``log``/``strict`` (unknown values mean ``off``)."""
+    raw = os.environ.get(_ENV_VAR, "").strip().lower()
+    if raw in ("strict", "disallow", "1", "true", "on"):
+        return "strict"
+    if raw in ("log", "warn"):
+        return "log"
+    return "off"
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    """Hashable abstract signature of a call: shape/dtype per array leaf,
+    type name otherwise -- the same identity jit caches on, cheaply."""
+
+    def one(a: Any):
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            return (str(getattr(a, "dtype", "?")), tuple(shape))
+        if isinstance(a, (list, tuple)):
+            return tuple(one(e) for e in a)
+        if isinstance(a, dict):
+            return tuple(sorted((k, one(v)) for k, v in a.items()))
+        return type(a).__name__
+
+    return (tuple(one(a) for a in args),
+            tuple(sorted((k, one(v)) for k, v in kwargs.items())))
+
+
+def apply(fn: Callable, mode: str | None = None) -> Callable:
+    """Wrap a hot jitted entry with the transfer guard.
+
+    With the guard off (the default) ``fn`` is returned unchanged -- no
+    wrapper frame on the hot path. Otherwise every call after the first
+    per argument signature runs under ``jax.transfer_guard``; ``strict``
+    raises on implicit transfers, ``log`` prints them."""
+    mode = resolve_transfer_guard() if mode is None else mode
+    if mode == "off":
+        return fn
+    guard_value = "disallow" if mode == "strict" else "log"
+    seen: set = set()
+
+    @functools.wraps(fn)
+    def guarded(*args, **kwargs):
+        import jax
+
+        sig = _signature(args, kwargs)
+        if sig not in seen:
+            # cold call: compiling transfers trace-time constants, which
+            # is legitimate exactly once per shape
+            out = fn(*args, **kwargs)
+            seen.add(sig)
+            return out
+        with jax.transfer_guard(guard_value):
+            return fn(*args, **kwargs)
+
+    guarded.__transfer_guard__ = mode  # introspection for tests
+    return guarded

@@ -319,6 +319,96 @@ CASES = [
             )(x)
         """,
     ),
+    (
+        "JL011",  # transfer-prone call on an unprovably-host value: a
+        # captured container's entry may hold a device array (the taint
+        # pass cannot see through the subscript -- JL001's blind spot)
+        """
+        import jax
+        import numpy as np
+
+        CACHE = {}
+
+        @jax.jit
+        def f(x):
+            return x + np.asarray(CACHE["k"])
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        CACHE = {}
+
+        @jax.jit
+        def f(x):
+            return x + jnp.asarray(CACHE["k"])
+        """,
+    ),
+    (
+        "JL012",  # fire-and-forget thread, no join/stop owner
+        """
+        import threading
+
+        def start_worker(fn):
+            threading.Thread(target=fn, daemon=True).start()
+        """,
+        """
+        import threading
+
+        def start_worker(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+        """,
+    ),
+    (
+        "JL013",  # lock attribute re-created outside __init__
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def reset(self):
+                self._lock = threading.Lock()
+        """,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def reset(self):
+                with self._lock:
+                    pass
+        """,
+    ),
+    (
+        "JL013",  # per-chip semaphore ring rebuilt outside __init__
+        """
+        import threading
+
+        class Router:
+            def __init__(self, n):
+                self._slots = [threading.Semaphore(2) for _ in range(n)]
+
+            def retune(self, n):
+                self._slots = [threading.Semaphore(2) for _ in range(n)]
+        """,
+        """
+        import threading
+
+        class Router:
+            def __init__(self, n):
+                self._slots = [threading.Semaphore(2) for _ in range(n)]
+
+            def retune(self, n):
+                for s in self._slots:
+                    s.release()
+        """,
+    ),
 ]
 
 

@@ -1,0 +1,593 @@
+"""rdp-racecheck (analysis/racecheck.py) + runtime sanitizer tests.
+
+Three layers, mirroring the tooling:
+
+- **static fixtures**: every RC rule fires on a seeded-bad module (a
+  two-lock inversion, an unguarded declared-field mutation, a blocking
+  call under a lock, the JL011-013 siblings live in test_jaxlint.py) and
+  stays silent on the disciplined equivalent, including the ``guarded_by``
+  def-annotation and ``*_locked`` escape conventions;
+- **runtime sanitizers**: ``RDP_LOCKCHECK`` instrumented locks raise on
+  order inversions / re-acquisition / hold-time in strict mode and record
+  in warn mode; ``RDP_TRANSFER_GUARD`` refuses implicit transfers on warm
+  jitted calls while exempting the (compiling) cold call;
+- **the package proof**: ``rdp-racecheck`` exits 0 over the package, the
+  extracted lock graph contains the known real edges (so the pass is not
+  vacuously clean), and the known-hairy DeviceRouter quarantine <->
+  watchdog-restart interleaving is proven cycle-free BOTH statically (no
+  RC001 over serving/) and dynamically (the chaos interleaving runs under
+  strict instrumented locks with zero violations).
+"""
+
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.analysis import racecheck
+from robotic_discovery_platform_tpu.resilience import configure_faults
+from robotic_discovery_platform_tpu.utils import lockcheck, transferguard
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "robotic_discovery_platform_tpu"
+
+
+@pytest.fixture(autouse=True)
+def _clean_sanitizer_state():
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+    configure_faults(None)
+
+
+def _analyze(tmp_path, source, name="mod.py"):
+    (tmp_path / name).write_text(textwrap.dedent(source))
+    return racecheck.analyze_paths([str(tmp_path)])
+
+
+def _rules(tmp_path, source):
+    return {f.rule for f in _analyze(tmp_path, source).findings}
+
+
+# -- RC001: lock-order cycles ------------------------------------------------
+
+
+def test_rc001_two_lock_inversion_fires(tmp_path):
+    res = _analyze(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        return 2
+        """)
+    rc001 = [f for f in res.findings if f.rule == "RC001"]
+    assert len(rc001) == 1
+    assert "mod.W._a" in rc001[0].message
+    assert "mod.W._b" in rc001[0].message
+
+
+def test_rc001_cycle_through_the_callgraph(tmp_path):
+    """The inversion hides one call deep: f holds A and calls g (which
+    takes B); h holds B and calls k (which takes A)."""
+    rules = _rules(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def take_b(self):
+                with self._b:
+                    return 1
+
+            def take_a(self):
+                with self._a:
+                    return 2
+
+            def f(self):
+                with self._a:
+                    return self.take_b()
+
+            def h(self):
+                with self._b:
+                    return self.take_a()
+        """)
+    assert "RC001" in rules
+
+
+def test_rc001_consistent_order_is_clean(tmp_path):
+    rules = _rules(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def f(self):
+                with self._a:
+                    with self._b:
+                        return 1
+
+            def g(self):
+                with self._a:
+                    with self._b:
+                        return 2
+        """)
+    assert "RC001" not in rules
+
+
+# -- RC002: guarded_by ---------------------------------------------------------
+
+
+_GUARDED = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded_by: _lock
+
+        def good(self):
+            with self._lock:
+                self._items.append(1)
+
+        def read_ok(self):
+            return len(self._items)
+
+        def _drain_locked(self):
+            self._items.clear()
+
+        def helper(self):  # guarded_by: _lock
+            self._items.pop()
+"""
+
+
+def test_rc002_unguarded_mutation_fires(tmp_path):
+    res = _analyze(tmp_path, _GUARDED + """
+        def bad(self):
+            self._items.append(2)
+    """)
+    rc002 = [f for f in res.findings if f.rule == "RC002"]
+    assert len(rc002) == 1
+    assert "_items" in rc002[0].message
+
+
+def test_rc002_conventions_escape(tmp_path):
+    """with-block, read-only access, *_locked suffix, and the def-line
+    guarded_by annotation all pass."""
+    assert "RC002" not in _rules(tmp_path, _GUARDED)
+
+
+def test_rc002_augassign_and_subscript_fire(tmp_path):
+    rules = _rules(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded_by: _lock
+                self._m = {}  # guarded_by: _lock
+
+            def bad_aug(self):
+                self._n += 1
+
+            def bad_sub(self):
+                self._m["k"] = 1
+        """)
+    assert "RC002" in rules
+
+
+# -- RC003: blocking under a lock ---------------------------------------------
+
+
+def test_rc003_queue_get_under_lock_fires(tmp_path):
+    res = _analyze(tmp_path, """
+        import queue
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad(self):
+                with self._lock:
+                    return self._q.get(timeout=1.0)
+
+            def fine(self):
+                with self._lock:
+                    return self._q.get_nowait()
+        """)
+    rc003 = [f for f in res.findings if f.rule == "RC003"]
+    assert len(rc003) == 1
+    assert ".get()" in rc003[0].message
+
+
+def test_rc003_sleep_join_result_fire_and_cond_wait_is_exempt(tmp_path):
+    res = _analyze(tmp_path, """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+                self._t = threading.Thread(target=print)
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(1)
+
+            def bad_join(self):
+                with self._lock:
+                    self._t.join()
+
+            def fine_wait(self):
+                # Condition.wait RELEASES the held condition: not blocking
+                with self._cond:
+                    self._cond.wait(0.1)
+        """)
+    rc003 = [f for f in res.findings if f.rule == "RC003"]
+    assert len(rc003) == 2
+    assert not any("fine_wait" in f.message for f in rc003)
+
+
+def test_inline_disable_suppresses(tmp_path):
+    rules = _rules(tmp_path, """
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def justified(self):
+                with self._lock:
+                    time.sleep(0.01)  # racecheck: disable=RC003
+        """)
+    assert "RC003" not in rules
+
+
+# -- driver / baseline ---------------------------------------------------------
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._xs = []  # guarded_by: _lock
+
+            def bad(self):
+                self._xs.append(1)
+        """))
+    assert racecheck.main([str(tmp_path), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "RC002" in out
+    # baseline with justification turns the run green; a stale entry
+    # fails it again after the finding is fixed
+    baseline = tmp_path / "rc.json"
+    assert racecheck.main(
+        [str(tmp_path), "--write-baseline", str(baseline)]) == 0
+    entries = __import__("json").loads(baseline.read_text())
+    for e in entries["entries"]:
+        e["justification"] = "known single-threaded in this fixture"
+    baseline.write_text(__import__("json").dumps(entries))
+    assert racecheck.main(
+        [str(tmp_path), "--baseline", str(baseline)]) == 0
+    bad.write_text("x = 1\n")
+    assert racecheck.main(
+        [str(tmp_path), "--baseline", str(baseline)]) == 1  # stale
+
+
+def test_package_racechecks_clean():
+    """The acceptance gate: rdp-racecheck exits 0 on the package."""
+    assert racecheck.main([str(PACKAGE)]) == 0
+
+
+def test_package_graph_is_not_vacuous():
+    """The clean run is meaningful only if the extractor actually sees
+    the serving stack's locks: the known real nesting edges must be in
+    the graph."""
+    res = racecheck.analyze_paths([str(PACKAGE)])
+    edges = set(res.graph.edges)
+    assert ("batching.BatchDispatcher._submit_lock",
+            "batching.BatchDispatcher._pending_lock") in edges
+    assert ("batching.BatchDispatcher._submit_lock",
+            "admission.DeadlineQueue._cond") in edges
+    assert ("profile.DriftMonitor._lock",
+            "sketch.StreamingSketch._lock") in edges
+
+
+def test_quarantine_watchdog_interleaving_is_cycle_free_statically():
+    """The PR's seeded worry: DeviceRouter quarantine (qlock + breaker)
+    interleaving with the watchdog's window reset (submit/inflight/pool/
+    pending locks). The package graph must contain those locks and no
+    cycle touching any of them."""
+    res = racecheck.analyze_paths([str(PACKAGE)])
+    batching_locks = {a for e in res.graph.edges for a in e
+                      if a.startswith("batching.")}
+    assert "batching.BatchDispatcher._submit_lock" in batching_locks
+    assert not [f for f in res.findings if f.rule == "RC001"]
+    assert not res.graph.cycles()
+
+
+# -- runtime lock sanitizer ----------------------------------------------------
+
+
+def _strict_locks(monkeypatch):
+    monkeypatch.setenv("RDP_LOCKCHECK", "strict")
+    lockcheck.reset()
+
+
+def test_checked_lock_is_plain_lock_when_off(monkeypatch):
+    monkeypatch.delenv("RDP_LOCKCHECK", raising=False)
+    lk = lockcheck.checked_lock("x")
+    assert not isinstance(lk, lockcheck.InstrumentedLock)
+    with lk:
+        pass
+
+
+def test_order_inversion_raises_in_strict(monkeypatch):
+    _strict_locks(monkeypatch)
+    a = lockcheck.checked_lock("test.a")
+    b = lockcheck.checked_lock("test.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(lockcheck.LockOrderInversion):
+            with a:
+                pass
+    # the failed acquisition must not leave ghost held state
+    assert lockcheck.held_locks() == []
+
+
+def test_order_inversion_logs_in_warn_mode(monkeypatch):
+    monkeypatch.setenv("RDP_LOCKCHECK", "warn")
+    lockcheck.reset()
+    a = lockcheck.checked_lock("test.a")
+    b = lockcheck.checked_lock("test.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert any("LockOrderInversion" in v for v in lockcheck.violations())
+
+
+def test_reacquisition_raises_instead_of_deadlocking(monkeypatch):
+    _strict_locks(monkeypatch)
+    lk = lockcheck.checked_lock("test.reacq")
+    with lk:
+        with pytest.raises(lockcheck.LockReacquired):
+            lk.acquire()
+
+
+def test_hold_time_violation_recorded(monkeypatch):
+    monkeypatch.setenv("RDP_LOCKCHECK", "warn")
+    lockcheck.reset()
+    lk = lockcheck.InstrumentedLock("test.slow", strict=False,
+                                    hold_s=0.01)
+    with lk:
+        time.sleep(0.05)
+    assert any("LockHeldTooLong" in v for v in lockcheck.violations())
+
+
+def test_held_locks_snapshot(monkeypatch):
+    _strict_locks(monkeypatch)
+    lk = lockcheck.checked_lock("test.held")
+    assert lockcheck.held_locks() == []
+    with lk:
+        held = lockcheck.held_locks()
+        assert len(held) == 1
+        assert held[0][1] == "test.held"
+    assert lockcheck.held_locks() == []
+
+
+def test_same_name_siblings_carry_no_order(monkeypatch):
+    """Per-instance locks sharing a name (every breaker, every metric
+    family child map) must not fabricate inversions against each other."""
+    _strict_locks(monkeypatch)
+    a1 = lockcheck.checked_lock("test.sib")
+    a2 = lockcheck.checked_lock("test.sib")
+    with a1:
+        with a2:
+            pass
+    with a2:
+        with a1:  # same name, opposite order: deliberately not flagged
+            pass
+
+
+def test_cross_thread_inversion_detected(monkeypatch):
+    """The edge graph is process-global: thread 1 establishes a->b,
+    thread 2's b->a attempt trips BEFORE it can actually deadlock."""
+    _strict_locks(monkeypatch)
+    a = lockcheck.checked_lock("test.t.a")
+    b = lockcheck.checked_lock("test.t.b")
+    caught: list = []
+
+    def one():
+        with a:
+            with b:
+                pass
+
+    def two():
+        with b:
+            try:
+                with a:
+                    pass
+            except lockcheck.LockOrderInversion as exc:
+                caught.append(exc)
+
+    t1 = threading.Thread(target=one)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=two)
+    t2.start()
+    t2.join()
+    assert len(caught) == 1
+
+
+# -- runtime transfer guard ----------------------------------------------------
+
+
+def test_resolvers(monkeypatch):
+    monkeypatch.delenv("RDP_TRANSFER_GUARD", raising=False)
+    assert transferguard.resolve_transfer_guard() == "off"
+    monkeypatch.setenv("RDP_TRANSFER_GUARD", "strict")
+    assert transferguard.resolve_transfer_guard() == "strict"
+    monkeypatch.setenv("RDP_TRANSFER_GUARD", "log")
+    assert transferguard.resolve_transfer_guard() == "log"
+    monkeypatch.setenv("RDP_TRANSFER_GUARD", "bogus")
+    assert transferguard.resolve_transfer_guard() == "off"
+    monkeypatch.delenv("RDP_LOCKCHECK", raising=False)
+    assert lockcheck.resolve_lockcheck() == "off"
+    monkeypatch.setenv("RDP_LOCKCHECK", "strict")
+    assert lockcheck.resolve_lockcheck() == "strict"
+
+
+def test_apply_off_returns_fn_unchanged():
+    def f(x):
+        return x
+
+    assert transferguard.apply(f, mode="off") is f
+
+
+def test_strict_guard_exempts_cold_call_and_trips_warm_implicit():
+    import jax
+
+    g = transferguard.apply(jax.jit(lambda x: x * 2), mode="strict")
+    x_np = np.ones((4,), np.float32)
+    # cold call: compiling, exempt (constants may transfer)
+    np.testing.assert_array_equal(np.asarray(g(x_np)), x_np * 2)
+    # warm call with a host numpy arg: implicit H2D, refused
+    with pytest.raises(Exception, match="Disallowed host-to-device"):
+        g(x_np)
+    # warm call with explicitly staged input: clean
+    x_dev = jax.device_put(x_np)
+    np.testing.assert_array_equal(np.asarray(g(x_dev)), x_np * 2)
+
+
+def test_log_mode_does_not_raise():
+    import jax
+
+    g = transferguard.apply(jax.jit(lambda x: x + 1), mode="log")
+    x = np.ones((3,), np.float32)
+    g(x)
+    np.testing.assert_array_equal(np.asarray(g(x)), x + 1)  # logged, not refused
+
+
+def test_serving_analyzer_is_guard_clean_when_staged(monkeypatch):
+    """The serving contract end to end: a batch analyzer built with the
+    guard armed accepts stage_batch-staged inputs on warm calls."""
+    import jax
+
+    monkeypatch.setenv("RDP_TRANSFER_GUARD", "strict")
+    from robotic_discovery_platform_tpu.ops import pipeline as pipeline_lib
+
+    @jax.jit
+    def fake_analyze(variables, frames, depths, intr, scales):
+        return {"s": frames.astype("float32").sum(axis=(1, 2, 3))}
+
+    guarded = transferguard.apply(fake_analyze, mode="strict")
+    variables = jax.device_put({"w": np.ones((2,), np.float32)})
+    frames = np.zeros((2, 8, 8, 3), np.uint8)
+    depths = np.zeros((2, 8, 8), np.uint16)
+    intr = np.repeat(np.eye(3, dtype=np.float32)[None], 2, 0)
+    scales = np.ones((2,), np.float32)
+    for _ in range(3):  # cold then warm: staged calls never trip
+        staged = pipeline_lib.stage_batch(frames, depths, intr, scales)
+        out = guarded(variables, *staged)
+    assert np.asarray(out["s"]).shape == (2,)
+
+
+# -- the dynamic quarantine <-> watchdog proof ---------------------------------
+
+
+def _submit_bg(d, outcomes, key, value):
+    frame = np.full((8, 8, 3), value % 251, np.uint8)
+
+    def run():
+        try:
+            outcomes[key] = d.submit(frame, np.zeros((8, 8), np.uint16),
+                                     np.eye(3, dtype=np.float32), 0.001,
+                                     timeout_s=30.0)
+        except BaseException as exc:  # noqa: BLE001 - recorded for assert
+            outcomes[key] = exc
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_chaos_quarantine_and_watchdog_restart_under_strict_locks(
+        monkeypatch):
+    """The satellite proof, dynamic half: chip-kill quarantine AND a
+    collector-killing fault (watchdog restart) interleave on a 4-chip
+    mesh with every dispatcher/router/breaker/metric lock instrumented in
+    strict mode -- any order inversion, re-acquisition, or ghost hold
+    raises inside the offending thread and fails the frames it owns. The
+    run must finish with every submit answered, zero recorded violations,
+    and no instrumented lock still held."""
+    _strict_locks(monkeypatch)
+    from robotic_discovery_platform_tpu.parallel import mesh as mesh_lib
+    from robotic_discovery_platform_tpu.serving.batching import (
+        BatchDispatcher,
+        DeviceRouter,
+    )
+
+    def analyze(frames, depths, intr, scales):
+        f = np.asarray(frames)
+        return {"sum": f.reshape(f.shape[0], -1).sum(axis=1)
+                .astype(np.int64)}
+
+    # chip 1 dies 3x (breaker threshold) then a collector kill forces a
+    # watchdog restart mid-quarantine: exactly the interleaving the lock
+    # graph must keep cycle-free
+    configure_faults(
+        "serving.chip.1.dispatch:exc:3,serving.batch.collect:exc:1")
+    router = DeviceRouter(
+        mesh_lib.make_serving_mesh(4), "round_robin",
+        breaker_failures=3, breaker_reset_s=0.2,
+    )
+    d = BatchDispatcher(analyze, window_ms=1.0, max_batch=1,
+                        max_inflight=2, router=router,
+                        watchdog_interval_s=0.05)
+    assert isinstance(d._pending_lock, lockcheck.InstrumentedLock)
+    assert isinstance(router._qlock, lockcheck.InstrumentedLock)
+    try:
+        outcomes: dict = {}
+        threads = [_submit_bg(d, outcomes, i, i) for i in range(24)]
+        for t in threads:
+            t.join(timeout=30)
+        # every submit answered: a real result, or an error-complete from
+        # the watchdog restart / failover budget -- never a hang
+        assert set(outcomes) == set(range(24))
+        assert router.quarantines_total >= 1 or d.collector_restarts >= 1
+    finally:
+        d.stop()
+    assert lockcheck.violations() == []
+    assert lockcheck.held_locks() == []
