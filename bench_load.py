@@ -536,11 +536,185 @@ def run_fleet_mode(cli, slo_ms: float, deadline_s: float | None,
     })
 
 
+# -- host-path profile -------------------------------------------------------
+
+#: the rdp_host_stage_split_seconds stages, handler order
+HOST_SPLIT_STAGES = ("decode", "admit", "stage_host", "h2d", "launch",
+                     "device", "d2h", "encode")
+#: the "host-side per-frame microseconds" headline: decode work + pooled
+#: staging + the explicit H2D enqueue (what the ingest overhaul attacks)
+HOST_US_STAGES = ("decode", "stage_host", "h2d")
+
+
+def _host_snapshot() -> dict[str, tuple[float, int]]:
+    """(sum_seconds, count) per tracked family/stage, read straight from
+    the in-process REGISTRY (the smoke server shares our process, so no
+    scrape parse); host_profile_delta diffs two of these."""
+    from robotic_discovery_platform_tpu.observability import (
+        instruments as obs,
+    )
+
+    snap: dict[str, tuple[float, int]] = {}
+    for stage in HOST_SPLIT_STAGES:
+        child = obs.HOST_STAGE_SPLIT.labels(stage=stage)
+        snap[f"split.{stage}"] = (child.sum, child.count)
+    for stage in ("decode", "device", "encode", "total"):
+        child = obs.STAGE_LATENCY.labels(stage=stage)
+        snap[f"stage.{stage}"] = (child.sum, child.count)
+    return snap
+
+
+def host_profile_delta(before: dict, after: dict) -> dict:
+    """One measured window's per-frame microsecond split: every stage's
+    (sum delta) / (frames delta), so per-dispatch and per-frame
+    observations normalize identically."""
+    frames = after["stage.total"][1] - before["stage.total"][1]
+    per_us = {}
+    for key in after:
+        ds = after[key][0] - before[key][0]
+        per_us[key] = round(1e6 * ds / frames, 2) if frames else 0.0
+    split_us = {s: per_us[f"split.{s}"] for s in HOST_SPLIT_STAGES}
+    handler_us = {s: per_us[f"stage.{s}"]
+                  for s in ("decode", "device", "encode")}
+    total_us = per_us["stage.total"]
+    return {
+        "frames": int(frames),
+        "split_us": split_us,
+        "handler_us": handler_us,
+        "total_us": total_us,
+        # the CI sanity gate: the handler-side stages are a partition of
+        # the per-frame total (response assembly is the remainder)
+        "handler_sum_us": round(sum(handler_us.values()), 2),
+        "host_us": round(sum(split_us[s] for s in HOST_US_STAGES), 2),
+    }
+
+
+def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
+                     load_spec, duration: float, frame_wh) -> None:
+    """``--host-profile``: the ingest overhaul's before/after proof.
+
+    Two legs at the SAME offered load (same Poisson seed): ``before`` =
+    the pre-overhaul host path (inline decode in the handler thread,
+    JPEG/PNG wire payloads) and ``after`` = the overhauled path (decode
+    worker pool + raw-format zero-copy payloads). Each leg's per-frame
+    microseconds are split into decode / admit / stage-host / H2D /
+    launch / device / D2H / encode by diffing the in-process
+    ``rdp_host_stage_split_seconds`` and ``rdp_stage_latency_seconds``
+    families around the measured window, and both splits land in
+    LOADBENCH.json rows tagged ``host_leg``. The headline is the
+    reduction in host-side microseconds (decode + staging)."""
+    import grpc
+
+    from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+    from robotic_discovery_platform_tpu.serving import client as client_lib
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+
+    w, h = frame_wh
+    abs_loads = [v for v, mult in load_spec if not mult]
+    rate = abs_loads[0] if abs_loads else 15.0
+    after_workers = (cli.decode_workers if cli.decode_workers
+                     else 4)
+    legs = (("before", 0, "encoded"),
+            ("after", after_workers, "raw"))
+    rows: list[dict] = []
+    profiles: dict[str, dict] = {}
+    warm_errors = 0
+    source = SyntheticSource(width=w, height=h, seed=cli.seed, n_frames=1)
+    source.start()
+    color, depth = source.get_frames()
+    source.stop()
+    for name, workers, fmt in legs:
+        server, servicer, address = boot_smoke_server(
+            slo_ms, decode_workers=workers)
+        channel = grpc.insecure_channel(address)
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        try:
+            request = client_lib.encode_request(color, depth, fmt=fmt)
+            for _ in range(3):
+                try:
+                    resps = list(
+                        stub.AnalyzeActuatorPerformance(iter([request]))
+                    )
+                    if any(r.status.startswith("ERROR") for r in resps):
+                        warm_errors += 1
+                except Exception:
+                    warm_errors += 1
+            servicer.warmup(w, h)
+            snap0 = _host_snapshot()
+            arrivals = poisson_arrivals(
+                rate, duration, np.random.default_rng(cli.seed))
+            lat_ms, errors, wall = run_level(
+                stub, request, arrivals, cli.workers, deadline_s)
+            prof = host_profile_delta(snap0, _host_snapshot())
+            row = summarize_level(lat_ms, errors, rate, wall, slo_ms)
+            row["host_leg"] = name
+            row["decode_workers"] = workers
+            row["wire_format"] = fmt
+            row["host_profile"] = prof
+            rows.append(row)
+            profiles[name] = prof
+            print(f"# host leg={name} workers={workers} fmt={fmt} "
+                  f"host_us={prof['host_us']} split={prof['split_us']}",
+                  file=sys.stderr)
+        finally:
+            channel.close()
+            server.stop(grace=None)
+            servicer.close()
+
+    before, after = profiles["before"], profiles["after"]
+    reduction = (1.0 - after["host_us"] / before["host_us"]
+                 if before["host_us"] > 0 else 0.0)
+    host_block = {
+        "offered_rps": rate,
+        "frame": [w, h],
+        "before": before,
+        "after": after,
+        "host_us_before": before["host_us"],
+        "host_us_after": after["host_us"],
+        "reduction_pct": round(100.0 * reduction, 1),
+    }
+
+    import jax
+
+    payload = {
+        "metric": "open_loop_tail_latency",
+        "backend": jax.default_backend(),
+        "unit": "ms",
+        "arrivals": "poisson",
+        "smoke": True,
+        "slo_ms": slo_ms,
+        "deadline_ms": (deadline_s * 1e3 if deadline_s else 0.0),
+        "workers": cli.workers,
+        "frame": [w, h],
+        "host_profile": host_block,
+        "rows": rows,
+    }
+    Path(cli.out).write_text(json.dumps(payload, indent=2) + "\n")
+
+    top = rows[-1] if rows else {}
+    p99 = top.get("p99_ms")
+    _emit_result({
+        "metric": "open_loop_tail_latency",
+        "backend": jax.default_backend(),
+        "value": p99 if p99 is not None and math.isfinite(p99) else 0.0,
+        "unit": "ms",
+        "offered_rps": rate,
+        "goodput_rps": top.get("goodput_rps", 0.0),
+        "violation_rate": top.get("violation_rate", 0.0),
+        "errors": warm_errors + sum(r["errors"] for r in rows),
+        "warm_errors": warm_errors,
+        "levels": len(rows),
+        "host": host_block,
+        "out": cli.out,
+        "smoke": True,
+    })
+
+
 # -- smoke server ------------------------------------------------------------
 
 
 def boot_smoke_server(slo_ms: float, controller: bool = False,
-                      chips: int = 1):
+                      chips: int = 1, decode_workers: int = 0):
     """An in-process CPU server shaped like tools/metrics_smoke.py's:
     tiny registered model, micro-batching ON (so the dispatcher, the
     flight recorder, and the serving.batch.* fault sites are all in the
@@ -551,7 +725,8 @@ def boot_smoke_server(slo_ms: float, controller: bool = False,
     smoke-scale time constants); False boots the control-off comparison
     leg (FIFO admission, static knobs -- the PR 2 behavior). ``chips``
     routes the dispatch window across that many faked CPU mesh chips
-    (the quarantine leg's topology)."""
+    (the quarantine leg's topology). ``decode_workers`` sizes the ingest
+    decode pool (0 = the historical inline decode)."""
     from robotic_discovery_platform_tpu.utils.platforms import (
         force_cpu_platform,
     )
@@ -613,6 +788,7 @@ def boot_smoke_server(slo_ms: float, controller: bool = False,
         controller_cooldown_s=0.5,
         chip_breaker_failures=3 if controller or chips > 1 else 0,
         chip_breaker_reset_s=2.0,
+        decode_workers=decode_workers,
     )
     # no warmup_shape here on purpose: an armed serving.batch.complete
     # fault would fire inside build_server's warm-up frame and abort the
@@ -656,6 +832,19 @@ def main() -> None:
                         help="RDP_FAULTS spec armed on replica 0 ONLY "
                              "(one degraded member inside a healthy "
                              "fleet), e.g. serving.batch.complete:exc:1")
+    parser.add_argument("--host-profile", action="store_true",
+                        help="host-path before/after profile: run the "
+                             "same offered load against the pre-overhaul "
+                             "ingest (inline decode, JPEG/PNG wire) and "
+                             "the overhauled one (decode pool + raw "
+                             "payloads), splitting per-frame microseconds "
+                             "into decode/admit/stage-host/H2D/launch/"
+                             "device/D2H/encode; needs --smoke")
+    parser.add_argument("--decode-workers", type=int, default=None,
+                        help="ingest decode-pool width for the smoke "
+                             "server ('after' leg of --host-profile, "
+                             "default 4 there; other smoke legs default "
+                             "to 0 = the historical inline decode)")
     parser.add_argument("--deadline-ms", type=float, default=None,
                         help="per-request gRPC deadline (default: the "
                              "SLO itself -- a client with a 250ms "
@@ -690,6 +879,13 @@ def main() -> None:
                      "needs --smoke")
     if cli.chips > 1 and not cli.smoke:
         parser.error("--chips shapes the smoke server; it needs --smoke")
+    if cli.host_profile:
+        if not cli.smoke:
+            parser.error("--host-profile boots per-leg smoke servers; it "
+                         "needs --smoke")
+        if cli.fleet or cli.controller != "off":
+            parser.error("--host-profile is its own comparison; drop "
+                         "--fleet/--controller")
     if cli.fleet:
         if not cli.smoke:
             parser.error("--fleet boots local CPU replicas; it needs "
@@ -726,6 +922,11 @@ def main() -> None:
                    else slo_ms)
     deadline_s = deadline_ms / 1e3 if deadline_ms > 0 else None
 
+    if cli.host_profile:
+        run_host_profile(cli, slo_ms, deadline_s, load_spec, duration,
+                         (w, h))
+        return
+
     if cli.fleet:
         run_fleet_mode(cli, slo_ms, deadline_s, load_spec, duration,
                        (w, h))
@@ -742,7 +943,8 @@ def main() -> None:
         server = servicer = None
         if cli.smoke:
             server, servicer, address = boot_smoke_server(
-                slo_ms, controller=(leg == "on"), chips=cli.chips
+                slo_ms, controller=(leg == "on"), chips=cli.chips,
+                decode_workers=(cli.decode_workers or 0),
             )
         else:
             address = cli.server

@@ -134,6 +134,42 @@ METRICS_ROWS_SKIPPED = REGISTRY.counter(
     "log the offline drift detector consumes.",
 )
 
+# -- host-path ingest (serving/ingest.py) ------------------------------------
+
+DECODE_SECONDS = REGISTRY.histogram(
+    "rdp_decode_seconds",
+    "Actual per-frame image-decode work (wherever it ran: decode worker "
+    "or inline handler thread), by wire payload format (encoded = "
+    "JPEG/PNG imdecode, raw = zero-copy frombuffer view, mixed).",
+    ("format",),
+)
+DECODE_QUEUE_DEPTH = REGISTRY.gauge(
+    "rdp_decode_queue_depth",
+    "Frames waiting in the decode worker pool's queue (0 with inline "
+    "decode, ServerConfig.decode_workers = 0).",
+)
+GEOMETRY_CACHE_HITS = REGISTRY.counter(
+    "rdp_geometry_cache_hits_total",
+    "Frames whose camera geometry (intrinsics + depth scale) was served "
+    "from the per-stream geometry cache -- no per-frame float32 "
+    "conversion, no re-staging.",
+)
+GEOMETRY_CACHE_MISSES = REGISTRY.counter(
+    "rdp_geometry_cache_misses_total",
+    "Geometry-cache misses (first sight of an intrinsics content / "
+    "frame geometry / depth-scale combination; a stream changing "
+    "intrinsics mid-stream misses into a fresh entry).",
+)
+HOST_STAGE_SPLIT = REGISTRY.histogram(
+    "rdp_host_stage_split_seconds",
+    "Per-frame host/device split the --host-profile bench reads: decode "
+    "(actual decode work), admit (submit to collected), stage_host "
+    "(pooled-buffer fill), h2d (explicit device_put staging), launch "
+    "(async jit dispatch), device (launch to completer pop), d2h "
+    "(blocking host fetch + fan-out), encode (response mask encode).",
+    ("stage",),
+)
+
 # -- batching ----------------------------------------------------------------
 
 BATCH_QUEUE_DEPTH = REGISTRY.gauge(

@@ -88,6 +88,15 @@ def stage_batch(frames_rgb, depths, intrinsics, depth_scales, device=None):
     Returns ``(frames, depths, intrinsics, depth_scales)`` as device
     arrays. ``jax.device_put`` is itself asynchronous, so staging batch
     N+1 overlaps batch N's compute.
+
+    Fill-in-place contract with the ingest path (serving/ingest.py +
+    ``_BucketBuffers.fill``): the host arrays arriving here are either
+    the dispatcher's pooled staging buffers (filled row-in-place, one
+    host copy per frame) or -- on the b == 1 fast path with raw-format
+    wire payloads -- zero-copy (possibly read-only) ``np.frombuffer``
+    views of the gRPC message buffer itself; ``device_put`` reads the
+    H2D transfer straight out of either with no intermediate copy, and
+    read-only inputs are first-class.
     """
     from jax.sharding import NamedSharding
 

@@ -453,7 +453,16 @@ class _BucketBuffers:
     ``np.stack`` copies per dispatch. A buffer set is exclusive to one
     in-flight dispatch (the completer returns it to the pool only after
     the dispatch's device work is done), so refilling can never race a
-    zero-copy ``device_put`` of a still-executing batch."""
+    zero-copy ``device_put`` of a still-executing batch.
+
+    Fill-in-place contract (:meth:`fill` / :meth:`pad`): a frame's row is
+    written straight from the pending frame's arrays into the slot this
+    dispatch checked out. For raw-format wire payloads
+    (serving/ingest.py) ``frame_rgb``/``depth`` are zero-copy
+    ``np.frombuffer`` views of the gRPC message buffer, so the wire
+    bytes land in the pooled slot with NO intermediate frame copy -- and
+    ``ops/pipeline.stage_batch``'s ``device_put`` then reads each chip's
+    H2D transfer straight out of these buffers."""
 
     __slots__ = ("key", "frames", "depths", "intr", "scales")
 
@@ -464,6 +473,23 @@ class _BucketBuffers:
         self.depths = np.empty((b, h, w), template.depth.dtype)
         self.intr = np.empty((b, 3, 3), np.float32)
         self.scales = np.empty((b,), np.float32)
+
+    def fill(self, i: int, p: _Pending) -> None:
+        """Write frame ``p`` into row ``i`` in place (the ONE host copy a
+        b > 1 frame pays between the wire and the device)."""
+        self.frames[i] = p.frame_rgb
+        self.depths[i] = p.depth
+        self.intr[i] = p.intrinsics
+        self.scales[i] = p.depth_scale
+
+    def pad(self, n: int) -> None:
+        """Replicate row 0 into the padding rows past ``n`` (skipped
+        entirely for full buckets)."""
+        if n < len(self.frames):
+            self.frames[n:] = self.frames[0]
+            self.depths[n:] = self.depths[0]
+            self.intr[n:] = self.intr[0]
+            self.scales[n:] = self.scales[0]
 
 
 @dataclass(eq=False)
@@ -492,6 +518,18 @@ class _Dispatch:
     # completer closes the root and records the timeline
     timeline: Any = None
     root: Any = None
+
+
+def _intrinsics_f32(intrinsics) -> np.ndarray:
+    """Intrinsics as float32 [3,3], converting ONLY when needed: the
+    serving layer hands in the geometry cache's float32 array
+    (serving/ingest.GeometryCache) and must not pay a per-frame re-wrap;
+    direct dispatcher users passing lists / float64 still convert."""
+    if (isinstance(intrinsics, np.ndarray)
+            and intrinsics.dtype == np.float32
+            and intrinsics.shape == (3, 3)):
+        return intrinsics
+    return np.asarray(intrinsics, np.float32)
 
 
 def _bucket(n: int, max_batch: int) -> int:
@@ -687,7 +725,7 @@ class BatchDispatcher:
         timeout = self._submit_timeout_s
         if timeout_s is not None:
             timeout = min(timeout, timeout_s)
-        p = _Pending(frame_rgb, depth, np.asarray(intrinsics, np.float32),
+        p = _Pending(frame_rgb, depth, _intrinsics_f32(intrinsics),
                      float(depth_scale), trace_ctx=trace.current(),
                      deadline_t=time.monotonic() + timeout)
         # enqueue under the lock stop() drains under: a submit either lands
@@ -1132,15 +1170,8 @@ class BatchDispatcher:
                first.depth.dtype.str)
         bufs = self._pool_take(key, first)
         for i, p in enumerate(group):
-            bufs.frames[i] = p.frame_rgb
-            bufs.depths[i] = p.depth
-            bufs.intr[i] = p.intrinsics
-            bufs.scales[i] = p.depth_scale
-        if n < b:
-            bufs.frames[n:] = bufs.frames[0]
-            bufs.depths[n:] = bufs.depths[0]
-            bufs.intr[n:] = bufs.intr[0]
-            bufs.scales[n:] = bufs.scales[0]
+            bufs.fill(i, p)
+        bufs.pad(n)
         return bufs, bufs.frames, bufs.depths, bufs.intr, bufs.scales
 
     def _launch_group(self, group: list[_Pending],
@@ -1197,8 +1228,14 @@ class BatchDispatcher:
             self.recent_batch += 0.25 * (n - self.recent_batch)
             b = self.bucket_for(n)
             tl.labels["bucket"] = str(b)
+            # per-frame admission wait (submit -> collected): the host
+            # split's "admit" column
+            for p in group:
+                obs.HOST_STAGE_SPLIT.labels(stage="admit").observe(
+                    max(0, collected_ns - p.submit_ns) / 1e9)
             t0 = time.monotonic_ns()
             bufs, frames, depths, intr, scales = self._stage_group(group, b)
+            t_fill = time.monotonic_ns()
             staged = pipeline_lib.stage_batch(
                 frames, depths, intr, scales, device=self._placement(chip)
             )
@@ -1211,6 +1248,14 @@ class BatchDispatcher:
             obs.BATCH_STAGE_LATENCY.labels(stage="stage").observe(
                 (t1 - t0) / 1e9)
             obs.BATCH_STAGE_LATENCY.labels(stage="launch").observe(
+                (t2 - t1) / 1e9)
+            # host/device split (bench_load --host-profile): pooled-buffer
+            # fill vs the explicit device_put enqueue vs the async launch
+            obs.HOST_STAGE_SPLIT.labels(stage="stage_host").observe(
+                (t_fill - t0) / 1e9)
+            obs.HOST_STAGE_SPLIT.labels(stage="h2d").observe(
+                (t1 - t_fill) / 1e9)
+            obs.HOST_STAGE_SPLIT.labels(stage="launch").observe(
                 (t2 - t1) / 1e9)
             with self._inflight_lock:
                 self._inflight_count += 1
@@ -1337,6 +1382,13 @@ class BatchDispatcher:
                 obs.BATCH_STAGE_LATENCY.labels(stage="complete").observe(
                     done_t - t_pop
                 )
+                # host split: launch -> completer pop approximates the
+                # device-side ride; pop -> done is the blocking D2H +
+                # fan-out the completer pays on the host
+                obs.HOST_STAGE_SPLIT.labels(stage="device").observe(
+                    max(0.0, t_pop - d.launch_t))
+                obs.HOST_STAGE_SPLIT.labels(stage="d2h").observe(
+                    done_t - t_pop)
                 self._pool_put(d.bufs)
                 with self._inflight_lock:
                     self._inflight_count = max(0, self._inflight_count - 1)

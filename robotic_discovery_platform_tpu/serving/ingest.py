@@ -1,0 +1,626 @@
+"""Host-path ingest: wire bytes -> ready-to-stage frames, off the GIL-bound
+handler thread.
+
+At 544 device-side FPS (BENCH_r03) the serving bottleneck is no longer the
+chip -- it is the Python host path: every frame used to pay ``cv2.imdecode``
+on the protobuf bytes *in the stream-handler thread*, a fresh
+BGR->RGB ``np.ascontiguousarray`` copy, and a per-frame
+``np.asarray(intrinsics)`` conversion, all serialized by the GIL
+(Clipper/Clockwork's core lesson, PAPERS.md: serving systems die on the
+host path). This module rebuilds that path in three measurable pieces:
+
+1. **Decode worker pool** (:class:`DecodePool`): a bounded pool of decode
+   threads (cv2 and numpy release the GIL in the heavy parts) turns
+   ``AnalysisRequest`` bytes into ready-to-stage RGB/depth arrays while
+   the handler thread is blocked on the *previous* frame's device ride.
+   ``ServerConfig.decode_workers`` / ``RDP_DECODE_WORKERS`` size the pool;
+   **0 = inline** -- decode runs synchronously in the handler thread,
+   byte-for-byte the historical path (the bitwise-parity serial mode).
+   Frames whose deadline is already blown while waiting in the decode
+   queue are shed *before* paying decode cost
+   (``rdp_shed_by_deadline_total{point="decode"}`` -- PR 7's admission
+   extended to pre-decode), and a watchdog restarts dead workers while
+   error-completing stranded frames, mirroring the batch dispatcher's
+   collector/completer recovery: no frame ever hangs.
+
+2. **Zero-copy staging**: decode works on ``np.frombuffer`` views of the
+   gRPC message buffer, and raw/uncompressed ``Image`` payloads (the
+   fleet-internal case, ``format = 1`` on the wire) bypass ``imdecode``
+   entirely -- the wire bytes ARE the frame, mapped as a zero-copy numpy
+   view that flows through the dispatcher's pooled staging buffers
+   (``_BucketBuffers.fill``: wire -> pooled slot, no intermediate frame
+   copy; the b == 1 fast path stages the view itself, zero host copies).
+   Encoded color frames convert BGR->RGB with one ``cv2.cvtColor`` pass
+   (bitwise-identical to the old fancy-index copy, measurably cheaper).
+
+3. **Per-stream geometry cache** (:class:`GeometryCache`): intrinsics and
+   depth scale are converted to float32 -- and ``device_put`` for the
+   direct (unbatched) path -- ONCE per distinct content (keyed on the
+   intrinsics bytes + frame geometry), so the per-frame
+   ``np.asarray(intrinsics, np.float32)`` and its implicit re-staging are
+   gone (``rdp_geometry_cache_hits_total`` / ``_misses_total``). A stream
+   that changes intrinsics mid-stream simply misses into a fresh entry.
+
+Fault-injection sites (resilience/faults.py): ``serving.ingest.decode``
+fires inside the per-frame decode guard (an injected failure
+error-completes that frame only; the worker keeps draining) and
+``serving.ingest.loop`` fires in the worker loop OUTSIDE the guard (kills
+the worker thread itself -- the watchdog-restart drill).
+
+Observability: ``rdp_decode_seconds{format}`` (actual decode work,
+wherever it ran), ``rdp_decode_queue_depth``,
+``rdp_host_stage_split_seconds{stage="decode"}`` (the host-path split
+``bench_load.py --host-profile`` reads), and one flight-recorder
+``ingest`` timeline per decoded frame whose ``decode`` span joins the
+dispatch timelines at ``GET /debug/spans``.
+
+Everything here is host-side; with ``decode_workers=0`` the serial
+depth-1 serving path stays bitwise-identical to the pre-ingest server.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+from robotic_discovery_platform_tpu.observability import (
+    instruments as obs,
+    recorder as recorder_lib,
+)
+from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
+from robotic_discovery_platform_tpu.serving.proto import vision_pb2
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_WORKERS_ENV_VAR = "RDP_DECODE_WORKERS"
+
+#: ``Image.format`` wire values (protos/vision.proto). The proto3 default
+#: of 0 is the historical encoded behavior, so the field is
+#: wire-compatible with pre-format clients.
+FORMAT_ENCODED = 0
+FORMAT_RAW = 1
+
+
+#: anything above this is "no deadline": grpc reports deadline-less
+#: streams as ~INT64_MAX nanoseconds (the same normalization the fleet
+#: front-end applies -- an un-normalized value overflows Event.wait)
+_NO_DEADLINE_S = 86400.0 * 365
+
+
+def normalize_remaining(remaining: float | None) -> float | None:
+    """A stream's remaining deadline budget, with grpc's
+    INT64_MAX-when-deadline-less sentinel normalized to None."""
+    if remaining is None or remaining > _NO_DEADLINE_S:
+        return None
+    return remaining
+
+
+def resolve_decode_workers(configured: int) -> int:
+    """The effective decode-pool width: ``RDP_DECODE_WORKERS`` when set,
+    else ``ServerConfig.decode_workers``. 0 = inline decode in the
+    handler thread (the bitwise-parity serial mode); negative = one
+    worker per available CPU."""
+    raw = os.environ.get(_WORKERS_ENV_VAR)
+    value = int(raw) if raw else int(configured)
+    if value < 0:
+        return max(1, os.cpu_count() or 1)
+    return value
+
+
+def default_intrinsics(w: int, h: int) -> np.ndarray:
+    """The focal-length fallback used when no calibration is loaded
+    (matches the reference's default camera model)."""
+    f = 0.94 * w
+    return np.array([[f, 0, w / 2], [0, f, h / 2], [0, 0, 1]], np.float64)
+
+
+def decode_color(img: vision_pb2.Image) -> np.ndarray:
+    """One color payload -> [H, W, 3] uint8 RGB.
+
+    Raw payloads map the wire bytes directly (``np.frombuffer`` view --
+    zero-copy, read-only; the analyzer and the staging buffers never
+    write into frames). Encoded payloads pay ``cv2.imdecode`` plus ONE
+    ``cv2.cvtColor`` BGR->RGB pass -- a channel permutation, so bitwise
+    identical to the historical ``np.ascontiguousarray(bgr[..., ::-1])``
+    at a fraction of its cost."""
+    if img.format == FORMAT_RAW:
+        expect = img.height * img.width * 3
+        if len(img.data) != expect:
+            raise ValueError(
+                f"raw color payload is {len(img.data)} bytes; expected "
+                f"{expect} for {img.width}x{img.height} RGB8"
+            )
+        return np.frombuffer(img.data, np.uint8).reshape(
+            img.height, img.width, 3
+        )
+    import cv2
+
+    bgr = cv2.imdecode(np.frombuffer(img.data, np.uint8), cv2.IMREAD_COLOR)
+    if bgr is None:
+        raise ValueError("failed to decode color payload")
+    return cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+
+
+def decode_depth(img: vision_pb2.Image) -> np.ndarray:
+    """One depth payload -> [H, W] uint16 (z16). Raw payloads are a
+    zero-copy little-endian view of the wire bytes."""
+    if img.format == FORMAT_RAW:
+        expect = img.height * img.width * 2
+        if len(img.data) != expect:
+            raise ValueError(
+                f"raw depth payload is {len(img.data)} bytes; expected "
+                f"{expect} for {img.width}x{img.height} z16"
+            )
+        return np.frombuffer(img.data, "<u2").reshape(img.height, img.width)
+    import cv2
+
+    depth = cv2.imdecode(
+        np.frombuffer(img.data, np.uint8), cv2.IMREAD_UNCHANGED
+    )
+    if depth is None:
+        raise ValueError("failed to decode depth payload")
+    if depth.dtype != np.uint16:
+        depth = depth.astype(np.uint16)
+    return depth
+
+
+def request_format(request: vision_pb2.AnalysisRequest) -> str:
+    """Label for the request's payload encoding: 'raw' (both images raw),
+    'encoded' (both encoded), or 'mixed'."""
+    c = request.color_image.format == FORMAT_RAW
+    d = request.depth_image.format == FORMAT_RAW
+    if c and d:
+        return "raw"
+    if not c and not d:
+        return "encoded"
+    return "mixed"
+
+
+def decode_request(
+    request: vision_pb2.AnalysisRequest,
+) -> tuple[np.ndarray, np.ndarray, str]:
+    """``AnalysisRequest`` -> ``(rgb [H,W,3] u8, depth [H,W] u16, fmt)``.
+    The per-frame decode core; callers wanting metrics/fault-injection
+    ride :meth:`DecodePool.decode` instead."""
+    fmt = request_format(request)
+    return (decode_color(request.color_image),
+            decode_depth(request.depth_image), fmt)
+
+
+# -- geometry cache ----------------------------------------------------------
+
+
+class GeometryEntry:
+    """One cached camera geometry: the float32 intrinsics the dispatcher
+    path stages per batch, plus lazily device-committed copies for the
+    direct (unbatched) path -- ``device_put`` once per entry instead of
+    once per frame, which is what keeps warm direct-path calls clean
+    under ``RDP_TRANSFER_GUARD=strict``."""
+
+    __slots__ = ("k_f32", "depth_scale", "_staged")
+
+    def __init__(self, k: np.ndarray, depth_scale: float):
+        self.k_f32 = np.ascontiguousarray(k, np.float32)
+        self.depth_scale = float(depth_scale)
+        self._staged: tuple | None = None
+
+    def staged(self) -> tuple:
+        """``(intrinsics, depth_scale)`` as committed device arrays.
+        Lazy: only the direct path pays the transfer. Benignly racy --
+        two threads can both stage on the first call; device_put is
+        idempotent and last-write-wins on the cache slot."""
+        s = self._staged
+        if s is None:
+            import jax
+
+            s = self._staged = (
+                jax.device_put(self.k_f32),
+                jax.device_put(np.float32(self.depth_scale)),
+            )
+        return s
+
+
+class GeometryCache:
+    """Content-keyed cache of per-stream camera geometry.
+
+    Keyed on the intrinsics CONTENT (bytes) plus frame geometry and depth
+    scale: repeated identical intrinsics -- the steady state of any
+    camera stream -- never re-convert or re-stage, and a stream that
+    changes intrinsics mid-stream simply misses into a fresh entry
+    (content keying IS the invalidation). Bounded LRU so a pathological
+    client cycling intrinsics cannot grow it without bound."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = max(1, int(capacity))
+        self._lock = checked_lock("ingest.geometry")
+        self._entries: OrderedDict[tuple, GeometryEntry] = OrderedDict()  # guarded_by: _lock
+
+    def lookup(self, intrinsics: np.ndarray | None, w: int, h: int,
+               depth_scale: float) -> GeometryEntry:
+        """The entry for this frame's geometry. ``intrinsics=None`` means
+        the focal-length default for (w, h) -- a hit costs no array
+        build at all."""
+        key = (w, h, float(depth_scale),
+               None if intrinsics is None
+               else np.asarray(intrinsics).tobytes())
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if entry is not None:
+            obs.GEOMETRY_CACHE_HITS.inc()
+            return entry
+        obs.GEOMETRY_CACHE_MISSES.inc()
+        k = intrinsics if intrinsics is not None else default_intrinsics(w, h)
+        entry = GeometryEntry(k, depth_scale)
+        with self._lock:
+            # a racing miss may have inserted first; keep the winner so
+            # both callers share one staged copy
+            entry = self._entries.setdefault(key, entry)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+        return entry
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# -- decode pool -------------------------------------------------------------
+
+
+@dataclass(eq=False)  # identity semantics: instances live in _pending sets
+class _PendingDecode:
+    """One decode job riding the pool queue."""
+
+    request: Any
+    #: absolute monotonic deadline; a worker popping a blown-deadline
+    #: frame sheds it BEFORE decoding (admission extended to pre-decode)
+    deadline_t: float | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+    rgb: np.ndarray | None = None
+    depth: np.ndarray | None = None
+    fmt: str = "encoded"
+    error: BaseException | None = None
+    queued_ns: int = field(default_factory=time.monotonic_ns)
+    #: seconds the decode itself took (0 when shed/errored before decode)
+    decode_s: float = 0.0
+
+
+@dataclass
+class IngestFrame:
+    """What the stream handler consumes: one ready-to-stage frame (or its
+    terminal error), plus the timing the serving metrics want."""
+
+    rgb: np.ndarray | None
+    depth: np.ndarray | None
+    error: BaseException | None
+    #: caller deadline budget observed when the request was read (the
+    #: submit timeout the handler forwards to the dispatcher)
+    time_remaining: float | None
+    #: seconds the HANDLER thread spent obtaining this frame (inline:
+    #: the decode itself; pooled: the wait, ~0 when prefetch won the race)
+    wait_s: float
+    fmt: str = "encoded"
+
+
+class DecodePool:
+    """Bounded pool of decode workers with the batch dispatcher's
+    liveness guarantees (watchdog restart, error-completed stranded
+    frames, drain-safe ``stop``).
+
+    ``workers=0`` runs no threads at all: :meth:`submit` decodes inline
+    and :meth:`iter_decoded` degenerates to the historical
+    read-check-decode loop -- the bitwise-parity mode every parity test
+    pins.
+    """
+
+    def __init__(self, workers: int, *, watchdog_interval_s: float = 1.0,
+                 prefetch: int = 2,
+                 flight_recorder: recorder_lib.FlightRecorder | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.workers = max(0, int(workers))
+        self.prefetch = max(1, int(prefetch))
+        self._clock = clock
+        self._recorder = (flight_recorder if flight_recorder is not None
+                          else recorder_lib.RECORDER)
+        self._q: queue.Queue[_PendingDecode | None] = queue.Queue()
+        self._stopped = threading.Event()
+        self._submit_lock = checked_lock("ingest.submit")
+        self._pending: set[_PendingDecode] = set()  # guarded_by: _pending_lock
+        self._pending_lock = checked_lock("ingest.pending")
+        self.worker_restarts = 0
+        self.sheds = 0
+        self._threads: list[threading.Thread] = []
+        self._watchdog: threading.Thread | None = None
+        if self.workers > 0:
+            self._threads = [self._start_worker(i)
+                             for i in range(self.workers)]
+            if watchdog_interval_s > 0:
+                self._watchdog = threading.Thread(
+                    target=self._watch, args=(watchdog_interval_s,),
+                    name="ingest-watchdog", daemon=True,
+                )
+                self._watchdog.start()
+
+    def _start_worker(self, i: int) -> threading.Thread:
+        t = threading.Thread(target=self._worker_loop,
+                             name=f"ingest-decode-{i}", daemon=True)
+        t.start()
+        return t
+
+    # -- decode core --------------------------------------------------------
+
+    def decode(self, request: vision_pb2.AnalysisRequest
+               ) -> tuple[np.ndarray, np.ndarray, str]:
+        """One guarded, timed decode (whichever thread runs it): the
+        ``serving.ingest.decode`` fault site, ``rdp_decode_seconds``,
+        the host-split ``decode`` stage, and one ``ingest`` flight-
+        recorder timeline whose ``decode`` span joins ``/debug/spans``."""
+        t0 = time.monotonic_ns()
+        inject("serving.ingest.decode")
+        rgb, depth, fmt = decode_request(request)
+        t1 = time.monotonic_ns()
+        dt = (t1 - t0) / 1e9
+        obs.DECODE_SECONDS.labels(format=fmt).observe(dt)
+        obs.HOST_STAGE_SPLIT.labels(stage="decode").observe(dt)
+        tl = recorder_lib.Timeline("ingest", labels={
+            "format": fmt,
+            "mode": "pool" if self.workers else "inline",
+        })
+        root = tl.span("ingest", start_ns=t0, end_ns=t1)
+        tl.span("decode", start_ns=t0, end_ns=t1, parent=root)
+        self._recorder.record(tl)
+        return rgb, depth, fmt
+
+    # -- caller side --------------------------------------------------------
+
+    def submit(self, request: vision_pb2.AnalysisRequest,
+               deadline_t: float | None = None) -> _PendingDecode:
+        """Enqueue one decode job (inline mode decodes synchronously).
+        The result is claimed with :meth:`wait`."""
+        p = _PendingDecode(request, deadline_t=deadline_t)
+        if self.workers == 0:
+            self._run_one(p, shed_check=False)
+            return p
+        with self._submit_lock:
+            if self._stopped.is_set():
+                p.error = RuntimeError("decode pool stopped")
+                p.done.set()
+                return p
+            with self._pending_lock:
+                self._pending.add(p)
+            self._q.put(p)
+        obs.DECODE_QUEUE_DEPTH.set(self._q.qsize())
+        return p
+
+    def wait(self, p: _PendingDecode, timeout_s: float | None = None) -> None:
+        """Block until ``p`` has a terminal outcome; on timeout the frame
+        is marked errored so a late decode is dropped, not delivered to
+        a caller that already gave up."""
+        if not p.done.wait(timeout_s):
+            p.error = DeadlineExceeded(
+                f"decode not ready within {timeout_s:.2f}s"
+            )
+        with self._pending_lock:
+            self._pending.discard(p)
+
+    # -- worker side --------------------------------------------------------
+
+    def _run_one(self, p: _PendingDecode, shed_check: bool = True) -> None:
+        try:
+            if (shed_check and p.deadline_t is not None
+                    and self._clock() > p.deadline_t):
+                # pre-decode shed: the deadline was blown while the frame
+                # sat in the decode queue -- decoding it would be work for
+                # a caller that can no longer use the result
+                self.sheds += 1
+                obs.SHED_BY_DEADLINE.labels(point="decode").inc()
+                raise DeadlineExceeded(
+                    "deadline blown in the decode queue; shed before "
+                    "paying decode cost"
+                )
+            t0 = time.perf_counter()
+            p.rgb, p.depth, p.fmt = self.decode(p.request)
+            p.decode_s = time.perf_counter() - t0
+        except BaseException as exc:  # deliver, don't kill the worker
+            p.error = exc
+        finally:
+            p.done.set()
+            with self._pending_lock:
+                self._pending.discard(p)
+
+    def _worker_loop(self) -> None:
+        while True:
+            p = self._q.get()
+            obs.DECODE_QUEUE_DEPTH.set(self._q.qsize())
+            if p is None:
+                return
+            # deliberately OUTSIDE the per-frame guard: an injected fault
+            # here kills the worker thread itself -- the watchdog drill
+            inject("serving.ingest.loop")
+            self._run_one(p)
+
+    # -- watchdog -----------------------------------------------------------
+
+    def _watch(self, interval_s: float) -> None:
+        """Mirror of the dispatcher's watchdog: a worker that died outside
+        its per-frame guard is restarted, and every pending frame is
+        error-completed NOW (a terminal outcome for each -- no submitter
+        waits out its full deadline against a threadless pool)."""
+        while not self._stopped.wait(interval_s):
+            dead = [i for i, t in enumerate(self._threads)
+                    if not t.is_alive()]
+            if not dead:
+                continue
+            with self._submit_lock:
+                if self._stopped.is_set():
+                    return
+                self.worker_restarts += len(dead)
+                obs.WATCHDOG_RESTARTS.inc()
+                self._recorder.record_event(
+                    "watchdog_restart", stage="ingest",
+                    error=f"{len(dead)} decode worker(s) died; "
+                          f"{len(self._pending)} pending frame(s) failed",
+                )
+                log.error(
+                    "%d decode worker(s) died unexpectedly; failing %d "
+                    "pending frame(s) and restarting (restart #%d)",
+                    len(dead), len(self._pending), self.worker_restarts,
+                )
+                while True:
+                    try:
+                        self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                obs.DECODE_QUEUE_DEPTH.set(0)
+                self._fail_pending(RuntimeError(
+                    "decode worker died; frame dropped"
+                ))
+                for i in dead:
+                    self._threads[i] = self._start_worker(i)
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        with self._pending_lock:
+            stranded = [p for p in self._pending if not p.done.is_set()]
+            self._pending.clear()
+        for p in stranded:
+            p.error = exc
+            p.done.set()
+
+    def stop(self) -> None:
+        """Idempotent. Every pending decode gets a terminal outcome."""
+        with self._submit_lock:
+            self._stopped.set()
+            for _ in self._threads:
+                self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
+        while True:
+            try:
+                p = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if p is not None and not p.done.is_set():
+                p.error = RuntimeError("decode pool stopped")
+                p.done.set()
+        self._fail_pending(RuntimeError("decode pool stopped"))
+
+    # -- stream side --------------------------------------------------------
+
+    def iter_decoded(
+        self,
+        request_iterator: Iterable,
+        *,
+        active: Callable[[], bool] = lambda: True,
+        time_remaining: Callable[[], float | None] = lambda: None,
+    ) -> Iterator[IngestFrame]:
+        """Yield one :class:`IngestFrame` per request, in order.
+
+        Inline mode (``workers=0``) reproduces the historical handler
+        loop exactly: check cancellation and deadline, decode, yield --
+        zero threads, bitwise-parity ordering. Pooled mode adds a
+        per-stream pump thread that reads ahead up to ``prefetch``
+        requests into the shared pool, so frame k+1 decodes while the
+        handler is blocked on frame k's device ride. A frame that fails
+        or is shed yields its error in place; the stream stays alive
+        (the server maps it to a per-frame status, as ever).
+        """
+        if self.workers == 0:
+            for request in request_iterator:
+                if not active():
+                    return
+                remaining = normalize_remaining(time_remaining())
+                if remaining is not None and remaining <= 0:
+                    return
+                t0 = time.perf_counter()
+                p = self.submit(request)
+                yield IngestFrame(p.rgb, p.depth, p.error, remaining,
+                                  time.perf_counter() - t0, p.fmt)
+            return
+        yield from self._iter_pooled(request_iterator, active,
+                                     time_remaining)
+
+    def _iter_pooled(self, request_iterator, active, time_remaining
+                     ) -> Iterator[IngestFrame]:
+        inbox: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stream_done = threading.Event()
+
+        def pump() -> None:
+            # the ONE consumer of the gRPC request iterator (same
+            # discipline as the fleet front-end's pump); bounded inbox =
+            # the read-ahead depth, so a slow handler backpressures here
+            try:
+                for request in request_iterator:
+                    if stream_done.is_set() or not active():
+                        return
+                    remaining = normalize_remaining(time_remaining())
+                    if remaining is not None and remaining <= 0:
+                        return
+                    deadline_t = (self._clock() + remaining
+                                  if remaining is not None else None)
+                    p = self.submit(request, deadline_t=deadline_t)
+                    item = (p, remaining)
+                    while True:
+                        try:
+                            inbox.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            if stream_done.is_set():
+                                return
+            except Exception as exc:  # noqa: BLE001 - client reset mid-read
+                if not stream_done.is_set():
+                    inbox.put(("error", exc))
+            finally:
+                stream_done_sentinel()
+
+        def stream_done_sentinel() -> None:
+            while not stream_done.is_set():
+                try:
+                    inbox.put(None, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        t = threading.Thread(target=pump, name="ingest-pump", daemon=True)
+        t.start()
+        try:
+            while True:
+                item = inbox.get()
+                if item is None:
+                    return
+                if item[0] == "error":
+                    raise item[1]
+                p, remaining = item
+                t0 = time.perf_counter()
+                # bounded wait: the caller's budget when it has one, the
+                # pool's generous ceiling otherwise (a watchdog-failed
+                # frame completes long before either)
+                self.wait(p, remaining if remaining is not None else 60.0)
+                yield IngestFrame(p.rgb, p.depth, p.error, remaining,
+                                  time.perf_counter() - t0, p.fmt)
+        finally:
+            stream_done.set()
+            # best-effort join; a pump blocked in the gRPC iterator read
+            # only unblocks when the RPC itself terminates (right after
+            # the handler returns), so the daemon thread may outlive this
+            # frame by one read -- it holds no locks and touches nothing
+            # after the stop flag is set
+            while True:
+                try:
+                    inbox.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=0.5)

@@ -254,6 +254,20 @@ class ServerConfig:
     quant_parity_frames: int = 4
     quant_parity_min_iou: float = 0.90
     quant_parity_max_curv_err: float = 0.5
+    # Host-path ingest (serving/ingest.py): decode worker pool width.
+    # 0 (default) decodes inline in the handler thread -- byte-for-byte
+    # the historical path, the bitwise-parity serial mode. N > 0 moves
+    # JPEG/PNG decode onto N pool threads (cv2 releases the GIL in the
+    # heavy parts) with per-stream read-ahead, so frame k+1 decodes while
+    # frame k rides the device; frames whose deadline is blown in the
+    # decode queue are shed BEFORE paying decode cost
+    # (rdp_shed_by_deadline_total{point="decode"}). Negative = one worker
+    # per CPU. The RDP_DECODE_WORKERS env var overrides this value.
+    decode_workers: int = 0
+    # How many requests each stream reads ahead into the decode pool
+    # (bounds per-stream decoded-frame memory; only meaningful with
+    # decode_workers > 0).
+    ingest_prefetch: int = 2
     # Model forward implementation: "auto" = Pallas-fused kernels on TPU,
     # Flax/XLA elsewhere; "flax" / "pallas" force one path (ops/pallas).
     model_forward: str = "auto"

@@ -45,13 +45,19 @@ class StageTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                self.totals[name] += dt
-                self.counts[name] += 1
-                self.last[name] = dt
-            if self.observer is not None:
-                self.observer(name, dt)
+            self.observe(name, time.perf_counter() - t0)
+
+    def observe(self, name: str, dt: float) -> None:
+        """Record one externally-measured sample for ``name`` (the ingest
+        path measures its handler-side wait itself and feeds it here, so
+        pooled decode timing rides the same accumulators and observer as
+        the context-managed stages)."""
+        with self._lock:
+            self.totals[name] += dt
+            self.counts[name] += 1
+            self.last[name] = dt
+        if self.observer is not None:
+            self.observer(name, dt)
 
     def last_ms(self, *names: str) -> float:
         with self._lock:

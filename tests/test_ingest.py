@@ -1,0 +1,457 @@
+"""Host-path ingest suite (serving/ingest.py): decode pool parity and
+liveness, raw-format fast path, pre-decode deadline shedding, the
+per-stream geometry cache, and the warmup/intrinsics host-path satellites.
+
+Runs clean under RDP_LOCKCHECK=strict / RDP_TRANSFER_GUARD=strict (the CI
+host-smoke job does exactly that)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.observability import (
+    instruments as obs,
+    recorder as recorder_lib,
+)
+from robotic_discovery_platform_tpu.resilience import (
+    DeadlineExceeded,
+    configure_faults,
+)
+from robotic_discovery_platform_tpu.serving import client as client_lib
+from robotic_discovery_platform_tpu.serving import ingest
+from robotic_discovery_platform_tpu.serving.proto import vision_pb2
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    configure_faults(None)
+    yield
+    configure_faults(None)
+
+
+def _frames(seed=0, w=64, h=48):
+    rng = np.random.default_rng(seed)
+    color_bgr = rng.integers(0, 255, (h, w, 3)).astype(np.uint8)
+    depth = rng.integers(0, 5000, (h, w)).astype(np.uint16)
+    return color_bgr, depth
+
+
+def _request(seed=0, fmt="encoded", w=64, h=48):
+    color_bgr, depth = _frames(seed, w, h)
+    return client_lib.encode_request(color_bgr, depth, fmt=fmt)
+
+
+# -- decode core -------------------------------------------------------------
+
+
+def test_encoded_decode_bitwise_matches_legacy_conversion():
+    """cv2.cvtColor(BGR2RGB) is a channel permutation: byte-for-byte the
+    old np.ascontiguousarray(bgr[..., ::-1]) -- the serial parity leg's
+    foundation."""
+    import cv2
+
+    req = _request(seed=3)
+    rgb, depth, fmt = ingest.decode_request(req)
+    assert fmt == "encoded"
+    bgr = cv2.imdecode(
+        np.frombuffer(req.color_image.data, np.uint8), cv2.IMREAD_COLOR
+    )
+    legacy = np.ascontiguousarray(bgr[..., ::-1])
+    assert np.array_equal(rgb, legacy)
+    legacy_depth = cv2.imdecode(
+        np.frombuffer(req.depth_image.data, np.uint8), cv2.IMREAD_UNCHANGED
+    )
+    assert np.array_equal(depth, legacy_depth)
+
+
+def test_raw_fast_path_is_exact_and_zero_copy():
+    """Raw payloads map the wire bytes as a read-only view: exact pixels
+    (no JPEG loss), no decode, no copy."""
+    import cv2
+
+    color_bgr, depth = _frames(seed=4)
+    req = _request(seed=4, fmt="raw")
+    rgb, d, fmt = ingest.decode_request(req)
+    assert fmt == "raw"
+    assert np.array_equal(rgb, cv2.cvtColor(color_bgr, cv2.COLOR_BGR2RGB))
+    assert np.array_equal(d, depth)
+    # zero-copy views of the protobuf bytes: read-only and no ownership
+    assert not rgb.flags.writeable and not d.flags.writeable
+    assert rgb.base is not None and d.base is not None
+
+
+def test_raw_vs_jpeg_within_roundtrip_tolerance():
+    """The raw fast path and the JPEG path see the same scene: identical
+    depth (PNG is lossless), color within JPEG roundtrip error (measured
+    on a structured frame -- pure noise is JPEG's pathological case)."""
+    yy, xx = np.mgrid[0:48, 0:64]
+    color_bgr = np.stack(
+        [(xx * 4) % 256, (yy * 5) % 256, ((xx + yy) * 2) % 256], axis=-1
+    ).astype(np.uint8)
+    depth = ((xx + 1) * 40).astype(np.uint16)
+    raw_req = client_lib.encode_request(color_bgr, depth, fmt="raw")
+    jpg_req = client_lib.encode_request(color_bgr, depth)
+    rgb_raw, d_raw, _ = ingest.decode_request(raw_req)
+    rgb_jpg, d_jpg, _ = ingest.decode_request(jpg_req)
+    assert np.array_equal(d_raw, d_jpg)
+    err = np.abs(rgb_raw.astype(np.int16) - rgb_jpg.astype(np.int16))
+    assert float(err.mean()) < 16.0
+
+
+def test_raw_payload_size_validation():
+    img = vision_pb2.Image(data=b"\x00" * 10, width=4, height=4,
+                           format=ingest.FORMAT_RAW)
+    with pytest.raises(ValueError, match="raw color payload"):
+        ingest.decode_color(img)
+    with pytest.raises(ValueError, match="raw depth payload"):
+        ingest.decode_depth(img)
+
+
+def test_decode_records_metrics_and_span():
+    rec = recorder_lib.FlightRecorder(capacity=8)
+    pool = ingest.DecodePool(0, flight_recorder=rec)
+    before = obs.DECODE_SECONDS.labels(format="raw").count
+    pool.decode(_request(seed=6, fmt="raw"))
+    assert obs.DECODE_SECONDS.labels(format="raw").count == before + 1
+    tls = rec.timelines()
+    assert tls and tls[-1].name == "ingest"
+    assert any(s.name == "decode" for s in tls[-1].spans)
+    pool.stop()
+
+
+# -- decode pool -------------------------------------------------------------
+
+
+def test_pool_parity_inline_vs_workers():
+    """workers=0 and workers=N produce bitwise-identical frames in
+    identical order on the same request stream."""
+    reqs = [_request(seed=i, fmt="raw" if i % 2 else "encoded")
+            for i in range(8)]
+    inline = ingest.DecodePool(0)
+    pooled = ingest.DecodePool(3)
+    try:
+        got0 = list(inline.iter_decoded(iter(reqs)))
+        got3 = list(pooled.iter_decoded(iter(reqs)))
+        assert len(got0) == len(got3) == len(reqs)
+        for a, b in zip(got0, got3):
+            assert a.error is None and b.error is None
+            assert np.array_equal(a.rgb, b.rgb)
+            assert np.array_equal(a.depth, b.depth)
+            assert a.fmt == b.fmt
+    finally:
+        inline.stop()
+        pooled.stop()
+
+
+def test_pool_decode_fault_errors_frame_not_worker():
+    """serving.ingest.decode fires inside the per-frame guard: the frame
+    errors, the worker survives, later frames decode fine."""
+    configure_faults("serving.ingest.decode:exc:1")
+    pool = ingest.DecodePool(1)
+    try:
+        frames = list(pool.iter_decoded(iter(
+            [_request(seed=i) for i in range(3)]
+        )))
+        assert len(frames) == 3
+        assert frames[0].error is not None
+        assert all(f.error is None for f in frames[1:])
+        assert all(t.is_alive() for t in pool._threads)
+    finally:
+        pool.stop()
+
+
+def test_pre_decode_deadline_shed_counted():
+    """A frame whose deadline is blown in the decode queue is shed
+    BEFORE decode and counted at point='decode'."""
+    pool = ingest.DecodePool(1)
+    shed_before = obs.SHED_BY_DEADLINE.labels(point="decode").value
+    try:
+        p = pool.submit(_request(seed=7),
+                        deadline_t=time.monotonic() - 1.0)
+        pool.wait(p, timeout_s=5.0)
+        assert isinstance(p.error, DeadlineExceeded)
+        assert p.rgb is None  # decode never ran
+        assert pool.sheds == 1
+        assert obs.SHED_BY_DEADLINE.labels(point="decode").value == \
+            shed_before + 1
+    finally:
+        pool.stop()
+
+
+def test_worker_death_watchdog_restart_zero_lost_frames():
+    """serving.ingest.loop kills a worker OUTSIDE the per-frame guard:
+    the watchdog restarts it, every in-flight frame gets a terminal
+    outcome (error, never a hang), and the restarted pool keeps
+    serving."""
+    configure_faults("serving.ingest.loop:exc:1")
+    pool = ingest.DecodePool(1, watchdog_interval_s=0.05)
+    try:
+        victim = pool.submit(_request(seed=8))
+        pool.wait(victim, timeout_s=10.0)
+        assert victim.error is not None  # terminal outcome, not a hang
+        deadline = time.monotonic() + 10.0
+        while pool.worker_restarts == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool.worker_restarts >= 1
+        # restarted pool serves: zero frames lost going forward
+        p = pool.submit(_request(seed=9))
+        pool.wait(p, timeout_s=10.0)
+        assert p.error is None and p.rgb is not None
+    finally:
+        pool.stop()
+
+
+def test_pool_stop_completes_stranded_frames():
+    pool = ingest.DecodePool(2)
+    pool.stop()
+    p = pool.submit(_request(seed=10))
+    assert p.done.is_set() and p.error is not None
+
+
+def test_iter_decoded_stops_on_inactive_stream():
+    pool = ingest.DecodePool(0)
+    reqs = iter([_request(seed=i) for i in range(5)])
+    seen = []
+    active = {"n": 0}
+
+    def is_active():
+        active["n"] += 1
+        return active["n"] <= 2  # third check reports cancellation
+
+    for f in pool.iter_decoded(reqs, active=is_active):
+        seen.append(f)
+    assert len(seen) == 2
+    pool.stop()
+
+
+def test_resolve_decode_workers(monkeypatch):
+    monkeypatch.delenv("RDP_DECODE_WORKERS", raising=False)
+    assert ingest.resolve_decode_workers(0) == 0
+    assert ingest.resolve_decode_workers(3) == 3
+    assert ingest.resolve_decode_workers(-1) >= 1
+    monkeypatch.setenv("RDP_DECODE_WORKERS", "5")
+    assert ingest.resolve_decode_workers(0) == 5
+
+
+# -- geometry cache ----------------------------------------------------------
+
+
+def test_geometry_cache_hit_miss_and_invalidation():
+    cache = ingest.GeometryCache()
+    hits0 = obs.GEOMETRY_CACHE_HITS.value
+    misses0 = obs.GEOMETRY_CACHE_MISSES.value
+    k = np.array([[100.0, 0, 32], [0, 100.0, 24], [0, 0, 1]])
+    e1 = cache.lookup(k, 64, 48, 0.001)
+    e2 = cache.lookup(k.copy(), 64, 48, 0.001)  # same CONTENT -> hit
+    assert e1 is e2
+    assert e1.k_f32.dtype == np.float32 and e1.k_f32.shape == (3, 3)
+    assert obs.GEOMETRY_CACHE_HITS.value == hits0 + 1
+    assert obs.GEOMETRY_CACHE_MISSES.value == misses0 + 1
+    # a stream changing intrinsics mid-stream: content keying IS the
+    # invalidation -- new content, fresh entry
+    k2 = k.copy()
+    k2[0, 0] = 120.0
+    e3 = cache.lookup(k2, 64, 48, 0.001)
+    assert e3 is not e1
+    assert obs.GEOMETRY_CACHE_MISSES.value == misses0 + 2
+    # depth-scale and frame geometry are part of the key
+    assert cache.lookup(k, 64, 48, 0.002) is not e1
+    assert cache.lookup(k, 128, 96, 0.001) is not e1
+
+
+def test_geometry_cache_default_intrinsics_and_staging():
+    cache = ingest.GeometryCache()
+    e1 = cache.lookup(None, 64, 48, 0.001)
+    assert e1 is cache.lookup(None, 64, 48, 0.001)
+    assert np.array_equal(
+        e1.k_f32, ingest.default_intrinsics(64, 48).astype(np.float32)
+    )
+    k_dev, scale_dev = e1.staged()
+    # staged ONCE: the committed device arrays are cached on the entry
+    assert e1.staged()[0] is k_dev and e1.staged()[1] is scale_dev
+    assert np.array_equal(np.asarray(k_dev), e1.k_f32)
+    assert float(np.asarray(scale_dev)) == pytest.approx(0.001)
+
+
+def test_geometry_cache_capacity_bounded():
+    cache = ingest.GeometryCache(capacity=4)
+    for i in range(10):
+        cache.lookup(None, 32 + i, 32, 0.001)
+    assert len(cache) == 4
+
+
+# -- satellites --------------------------------------------------------------
+
+
+def test_submit_intrinsics_converted_only_when_needed():
+    """The _Pending satellite: a caller already passing a float32 [3,3]
+    array keeps the SAME object (no per-frame re-wrap); anything else
+    still converts."""
+    from robotic_discovery_platform_tpu.serving.batching import (
+        _intrinsics_f32,
+    )
+
+    k32 = np.eye(3, dtype=np.float32)
+    assert _intrinsics_f32(k32) is k32
+    k64 = np.eye(3)
+    out = _intrinsics_f32(k64)
+    assert out is not k64 and out.dtype == np.float32
+    out = _intrinsics_f32([[1.0, 0, 0], [0, 1.0, 0], [0, 0, 1.0]])
+    assert isinstance(out, np.ndarray) and out.shape == (3, 3)
+
+
+def test_bucket_buffers_fill_in_place_from_raw_views():
+    """_BucketBuffers.fill writes wire-view frames straight into the
+    pooled slot (the one host copy a b>1 raw frame pays)."""
+    from robotic_discovery_platform_tpu.serving.batching import (
+        _BucketBuffers,
+        _Pending,
+    )
+
+    color_bgr, depth = _frames(seed=11, w=8, h=8)
+    req = client_lib.encode_request(color_bgr, depth, fmt="raw")
+    rgb, d, _ = ingest.decode_request(req)
+    p = _Pending(rgb, d, np.eye(3, dtype=np.float32), 0.5)
+    bufs = _BucketBuffers((2,), p, 2)
+    bufs.fill(0, p)
+    bufs.pad(1)
+    assert np.array_equal(bufs.frames[0], rgb)
+    assert np.array_equal(bufs.frames[1], rgb)  # padding replicates row 0
+    assert np.array_equal(bufs.depths[0], d)
+    assert bufs.scales[1] == np.float32(0.5)
+
+
+def test_warm_frames_built_once_per_shape():
+    from robotic_discovery_platform_tpu.serving import server as server_lib
+
+    server_lib._warm_frames.cache_clear()
+    a = server_lib._warm_frames(40, 32)
+    b = server_lib._warm_frames(40, 32)
+    assert a[0] is b[0] and a[1] is b[1]
+    info = server_lib._warm_frames.cache_info()
+    assert info.hits == 1 and info.misses == 1
+    c = server_lib._warm_frames(48, 32)
+    assert c[0] is not a[0]
+
+
+# -- end to end --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("workers", [0, 2])
+def test_stream_pipeline_parity_through_pool(workers):
+    """The handler-facing iterator path: identical streams through the
+    inline and pooled ingest produce identical frames in order, and the
+    pooled path overlaps (read-ahead primes the next frame while the
+    consumer sleeps, wait ~0 for later frames under a slow consumer)."""
+    reqs = [_request(seed=i, fmt="raw") for i in range(6)]
+    pool = ingest.DecodePool(workers, prefetch=2)
+    try:
+        out = []
+        for f in pool.iter_decoded(iter(reqs)):
+            assert f.error is None
+            out.append(f.rgb[0, 0].copy())
+            time.sleep(0.01)  # a slow consumer (device-bound handler)
+        assert len(out) == 6
+        expected = [ingest.decode_request(r)[0][0, 0] for r in reqs]
+        assert all(np.array_equal(a, b) for a, b in zip(out, expected))
+    finally:
+        pool.stop()
+
+
+def test_pooled_iterator_backpressures_not_unbounded():
+    """The pump reads ahead at most `prefetch` requests: an unbounded
+    read-ahead would buffer the whole stream in memory."""
+    pulled = []
+
+    def gen():
+        for i in range(50):
+            pulled.append(i)
+            yield _request(seed=i, fmt="raw")
+
+    pool = ingest.DecodePool(2, prefetch=2)
+    try:
+        it = pool.iter_decoded(gen())
+        first = next(it)
+        assert first.error is None
+        time.sleep(0.3)
+        # 1 yielded + inbox(2) + in-pool/in-hand slack; far below 50
+        assert len(pulled) <= 8
+        consumed = 1 + sum(1 for _ in it)
+        assert consumed == 50
+    finally:
+        pool.stop()
+
+
+def test_server_raw_end_to_end(tmp_path):
+    """Raw-format requests serve end to end through the real gRPC server
+    with a pooled ingest, and match the encoded path's analysis within
+    JPEG tolerance (depth-derived geometry identical)."""
+    import grpc
+    import jax
+
+    from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.serving import server as server_lib
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+    from robotic_discovery_platform_tpu.utils.config import (
+        ModelConfig,
+        ServerConfig,
+    )
+
+    uri = f"file:{tmp_path}/mlruns"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = init_unet(model, jax.random.key(0), img_size=64)
+    with tracking.start_run():
+        version = tracking.log_model(
+            variables, mcfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", version
+    )
+    responses = {}
+    for workers in (0, 2):
+        cfg = ServerConfig(
+            address="localhost:0",
+            tracking_uri=uri,
+            model_img_size=64,
+            metrics_csv=str(tmp_path / f"metrics{workers}.csv"),
+            calibration_path=str(tmp_path / "missing.npz"),
+            reload_poll_s=0.0,
+            decode_workers=workers,
+        )
+        server, servicer = server_lib.build_server(cfg)
+        port = server.add_insecure_port("localhost:0")
+        server.start()
+        try:
+            channel = grpc.insecure_channel(f"localhost:{port}")
+            stub = vision_grpc.VisionAnalysisServiceStub(channel)
+            color_bgr, depth = _frames(seed=12, w=64, h=64)
+            depth[16:48, 16:48] = 1200  # a solid geometry patch
+            reqs = [client_lib.encode_request(color_bgr, depth, fmt=f)
+                    for f in ("raw", "raw", "encoded")]
+            got = list(stub.AnalyzeActuatorPerformance(iter(reqs)))
+            assert len(got) == 3
+            for r in got:
+                assert not r.status.startswith("ERROR"), r.status
+                r.proc_time_ms = 0.0  # wall time differs run to run
+            responses[workers] = got
+            channel.close()
+        finally:
+            server.stop(grace=None)
+            servicer.close()
+    # decode-pool parity: workers=0 vs workers=2 are byte-identical on
+    # the identical stream (the acceptance criterion's parity leg)
+    for a, b in zip(responses[0], responses[2]):
+        assert a.SerializeToString(deterministic=True) == \
+            b.SerializeToString(deterministic=True)
+    # raw frames are deterministic: the two raw responses agree exactly
+    r0, r1, _ = responses[0]
+    assert r0.mask == r1.mask
+    assert r0.mean_curvature == r1.mean_curvature

@@ -40,6 +40,12 @@ REQUIRED_FAMILIES = (
     "rdp_drift_reference_age_seconds",
     "rdp_model_confidence_margin",
     "rdp_metrics_rows_skipped_total",
+    # host-path ingest (PR 12)
+    "rdp_decode_seconds",
+    "rdp_decode_queue_depth",
+    "rdp_geometry_cache_hits_total",
+    "rdp_geometry_cache_misses_total",
+    "rdp_host_stage_split_seconds",
 )
 #: the signals the online drift monitor must expose in /debug/drift
 DRIFT_SIGNALS = (
@@ -59,6 +65,11 @@ REQUIRED_SAMPLES = (
     'rdp_slo_error_budget_burn{objective="e2e"}',
     # every streamed frame observes its confidence margin
     "rdp_model_confidence_margin_count",
+    # host-path ingest: every frame's decode work is measured and the
+    # steady-state stream hits the geometry cache after its first frame
+    'rdp_decode_seconds_count{format="encoded"}',
+    'rdp_host_stage_split_seconds_count{stage="decode"}',
+    'rdp_host_stage_split_seconds_count{stage="encode"}',
 )
 
 
@@ -155,9 +166,24 @@ def main() -> int:
             timeout=30,
         ) as resp:
             drift_payload = json.loads(resp.read().decode())
+        # every decoded frame records an ingest timeline whose "decode"
+        # span joins the dispatch timelines at /debug/spans
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{servicer.metrics_server.port}/debug/spans",
+            timeout=30,
+        ) as resp:
+            spans_payload = json.loads(resp.read().decode())
     finally:
         server.stop(grace=None)
         servicer.close()
+
+    decode_spans = [
+        s for t in spans_payload.get("recent", [])
+        for s in t.get("spans", []) if s.get("name") == "decode"
+    ]
+    if not decode_spans:
+        print("FAIL: no 'decode' span in /debug/spans timelines")
+        return 1
 
     if not drift_payload.get("enabled"):
         print(f"FAIL: /debug/drift reports disabled: {drift_payload}")
