@@ -189,6 +189,20 @@ def score_sketches(ref: StreamingSketch,
     )
 
 
+def score_value_lists(spec: SignalSpec, ref_values: Sequence[float],
+                      live_values: Sequence[float]) -> DriftScore:
+    """Score two raw value sequences under one declared binning -- the
+    shadow gate's comparison (serving/rollout.py): candidate-vs-serving
+    signal values over the SAME mirrored frames, so the two sides share
+    their sampling noise."""
+    return score_sketches(
+        StreamingSketch.from_values(spec.lo, spec.hi, spec.bins,
+                                    ref_values),
+        StreamingSketch.from_values(spec.lo, spec.hi, spec.bins,
+                                    live_values),
+    )
+
+
 # -- reference profiles ------------------------------------------------------
 
 
@@ -450,6 +464,10 @@ class DriftMonitor:
         the old reference say nothing about the new one."""
         with self._lock:
             self._reference = profile
+            if profile.generation is not None:
+                # the monitor's own stamp follows the adopted reference,
+                # so snapshot()["generation"] is single-sourced
+                self.generation = profile.generation
             self.spec = dict(profile.spec)
             self._reset_live_locked()
         log.info(
@@ -634,6 +652,15 @@ class DriftMonitor:
             return {
                 "enabled": True,
                 "state": state,
+                # the generation this monitor is currently anchored to:
+                # the reference's when one exists, else the stamp the
+                # next self-baseline will carry. Promotion swaps this
+                # together with the engine generation (serving/server.py
+                # maybe_reload), and /debug/drift consumers assert the
+                # pair never mixes.
+                "generation": (ref.generation if ref is not None
+                               and ref.generation is not None
+                               else self.generation),
                 "frames_observed": self._frames,
                 "baseline_frames": self.baseline_frames,
                 "thresholds": {

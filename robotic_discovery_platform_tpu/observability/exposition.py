@@ -18,6 +18,11 @@ down with it. It serves:
   recommendation ladder; monitoring/profile.py). The serving layer
   installs the provider via :meth:`MetricsServer.set_drift_provider`;
   without one the endpoint reports ``{"enabled": false}``;
+- ``GET /debug/rollout`` -- the drift-triggered rollout state machine's
+  state as JSON (current stage, in-flight cycle, completed-cycle
+  history with per-stage timings and gate verdicts;
+  serving/rollout.py). Installed via
+  :meth:`MetricsServer.set_rollout_provider`, same contract as drift;
 - ``GET /debug/profile?seconds=N`` -- an on-demand ``jax.profiler``
   capture into ``RDP_PROFILE_DIR`` (409 when unset or a capture is
   already running), so a TPU profile can be pulled from a live server
@@ -115,6 +120,8 @@ class MetricsServer:
         # serving layer (the servicer owns the DriftMonitor and is built
         # after the endpoint starts)
         self._drift_provider = drift_provider
+        # same contract for the rollout state machine (serving/rollout.py)
+        self._rollout_provider = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -141,12 +148,24 @@ class MetricsServer:
                         })
                     else:
                         self._send_json(provider())
+                elif path == "/debug/rollout":
+                    provider = outer._rollout_provider
+                    if provider is None:
+                        self._send_json({
+                            "enabled": False,
+                            "reason": "no rollout manager attached "
+                                      "(RolloutConfig.enabled / "
+                                      "RDP_ROLLOUT)",
+                        })
+                    else:
+                        self._send_json(provider())
                 elif path == "/debug/profile":
                     self._profile(query)
                 else:
                     self.send_error(
                         404, "try /metrics, /debug/spans, /debug/tracez, "
-                             "/debug/drift, or /debug/profile?seconds=N")
+                             "/debug/drift, /debug/rollout, or "
+                             "/debug/profile?seconds=N")
 
             def _send_json(self, payload: dict, status: int = 200):
                 body = json.dumps(payload, indent=1).encode("utf-8")
@@ -204,6 +223,13 @@ class MetricsServer:
         """Install (or clear) the ``GET /debug/drift`` payload source: a
         zero-arg callable returning a JSON-able dict."""
         self._drift_provider = provider
+
+    def set_rollout_provider(self, provider) -> None:
+        """Install (or clear) the ``GET /debug/rollout`` payload source
+        (a zero-arg callable returning a JSON-able dict -- the rollout
+        manager's :meth:`~robotic_discovery_platform_tpu.serving.rollout.
+        RolloutManager.snapshot`)."""
+        self._rollout_provider = provider
 
     def start(self) -> "MetricsServer":
         if self._thread is None:

@@ -133,6 +133,66 @@ METRICS_ROWS_SKIPPED = REGISTRY.counter(
     "skipped by the CSV MetricsWriter instead of being written into the "
     "log the offline drift detector consumes.",
 )
+DRIFT_PROFILE_FAILURES = REGISTRY.counter(
+    "rdp_drift_profile_failures_total",
+    "Retraining-pipeline drift-profile captures that failed (the "
+    "promoted version shipped no reference artifact, so every server "
+    "adopting it silently self-baselines on its own early traffic "
+    "instead of the eval set -- non-fatal, but a fleet doing it "
+    "repeatedly is flying blind).",
+)
+
+# -- drift-triggered rollout (serving/rollout.py; RolloutConfig) --------------
+
+ROLLOUT_STATE = REGISTRY.gauge(
+    "rdp_rollout_state",
+    "Info gauge: 1 on the label of the rollout state machine's current "
+    "stage (idle, draining, retraining, shadow, canary, promoting, "
+    "rejoining), 0 on the others.",
+    ("state",),
+)
+ROLLOUT_TRANSITIONS = REGISTRY.counter(
+    "rdp_rollout_transitions_total",
+    "Rollout state-machine transitions, by destination stage (each is "
+    "also pinned in the flight recorder).",
+    ("to",),
+)
+ROLLOUT_SHADOW_FRAMES = REGISTRY.counter(
+    "rdp_rollout_shadow_frames_total",
+    "Live frames mirrored to the shadow candidate, by outcome: "
+    "'mirrored' (sampled into the shadow queue), 'diffed' (candidate "
+    "ran it and the diff was scored), 'dropped' (shadow queue full -- "
+    "the mirror never blocks serving), 'error' (candidate raised on the "
+    "frame; counts against the gate).",
+    ("outcome",),
+)
+ROLLOUT_GATE_VERDICTS = REGISTRY.counter(
+    "rdp_rollout_gate_verdicts_total",
+    "Promotion-gate evaluations, by gate (fixture_iou, fixture_curv, "
+    "shadow_iou, shadow_curv, shadow_psi, shadow_frames) and verdict "
+    "(pass, fail). Promotion requires every gate to pass -- fail-closed.",
+    ("gate", "verdict"),
+)
+ROLLOUT_ROLLBACKS = REGISTRY.counter(
+    "rdp_rollout_rollbacks_total",
+    "Rollout cycles rolled back, by the stage that failed or timed out "
+    "(the candidate is discarded, the drained replica rejoins, and the "
+    "fleet keeps serving the old generation).",
+    ("stage",),
+)
+ROLLOUT_CYCLES = REGISTRY.counter(
+    "rdp_rollout_cycles_total",
+    "Completed rollout cycles, by outcome (promoted, rolled_back).",
+    ("outcome",),
+)
+ROLLOUT_SKIPPED = REGISTRY.counter(
+    "rdp_rollout_skipped_total",
+    "Retrain recommendations the rollout manager did NOT act on, by "
+    "reason: 'busy' (a cycle is already running), 'no_spare_replica' "
+    "(draining one would leave nothing serving -- the loop never trades "
+    "availability for freshness).",
+    ("reason",),
+)
 
 # -- host-path ingest (serving/ingest.py) ------------------------------------
 
@@ -300,6 +360,13 @@ FLEET_REPLICAS_QUARANTINED = REGISTRY.gauge(
     "per-replica circuit breaker while their health endpoint still "
     "answers (stream-level failures quarantine faster than the health "
     "poll notices).",
+)
+FLEET_REPLICAS_DRAINING = REGISTRY.gauge(
+    "rdp_fleet_replicas_draining",
+    "Replicas reporting draining=true over the stats RPC: held out of "
+    "NEW-stream placement while still healthy (graceful drain -- "
+    "in-flight streams finish normally, nothing fails over), e.g. a "
+    "rollout cycle borrowing the replica's chips for retraining.",
 )
 FLEET_REPLICA_STREAMS = REGISTRY.gauge(
     "rdp_fleet_replica_streams",

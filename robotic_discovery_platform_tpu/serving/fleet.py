@@ -18,7 +18,11 @@ level up:
   rejoins on recovery through a half-open probe (the per-replica
   :class:`~robotic_discovery_platform_tpu.resilience.CircuitBreaker`
   admits one health probe after ``fleet_breaker_reset_s``; success
-  reinstates).
+  reinstates). A replica reporting ``draining=true`` over the stats RPC
+  (a rollout cycle borrowing its chips, serving/rollout.py) leaves
+  NEW-stream placement BEFORE health ever flips: a graceful drain, not
+  a failover -- its in-flight streams finish normally and the breaker
+  never trips.
 - **Placement is least-loaded with ring tie-break**, fed by each
   replica's reported inflight/burn: a lightweight stats RPC
   (:func:`add_replica_stats_to_server`, a JSON-over-gRPC unary the
@@ -175,6 +179,11 @@ class Replica:
         self._stats_stub = None
         #: last health-poll verdict (SERVING and reachable)
         self.serving = False
+        #: replica reports draining=true over the stats RPC: healthy but
+        #: asking for no NEW streams (rollout drain / pre-stop). Distinct
+        #: from a health drop-out on purpose -- in-flight streams finish
+        #: normally instead of failing over, and the breaker never trips.
+        self.draining = False
         #: front-end-placed streams currently open on this replica
         self.inflight = 0
         #: frames relayed through this replica (front-end count)
@@ -224,10 +233,14 @@ class Replica:
 
     @property
     def placeable(self) -> bool:
-        """In the ring: last health probe said SERVING and the breaker is
+        """In the ring: last health probe said SERVING, the breaker is
         closed (an open breaker = quarantined until its half-open probe
-        succeeds)."""
-        return self.serving and self.breaker.state == CLOSED
+        succeeds), and the replica is not asking for a graceful drain --
+        ``draining`` takes it out of NEW-stream placement BEFORE health
+        ever flips, so its in-flight streams run to completion instead
+        of failing over."""
+        return (self.serving and self.breaker.state == CLOSED
+                and not self.draining)
 
     @property
     def effective_load(self) -> float:
@@ -390,12 +403,22 @@ class FleetRouter:
             r.burn = float(stats.get("burn", 0.0))
         except (TypeError, ValueError):
             r.burn = 0.0
+        was_draining = r.draining
+        r.draining = bool(stats.get("draining", False))
+        if r.draining != was_draining:
+            log.info(
+                "fleet membership: replica %s %s (graceful drain, health "
+                "still SERVING)", r.endpoint,
+                "draining -- out of new-stream placement" if r.draining
+                else "un-drained -- placeable again",
+            )
         obs.FLEET_REPLICA_BURN.labels(replica=r.endpoint).set(r.burn)
 
     def _publish_membership(self) -> int:
         live = self.live_count
         obs.FLEET_REPLICAS_LIVE.set(live)
         obs.FLEET_REPLICAS_QUARANTINED.set(self.quarantined_count)
+        obs.FLEET_REPLICAS_DRAINING.set(self.draining_count)
         # the change test runs under the lock: _publish_membership is
         # reached from the poll thread AND from stream handlers
         # (on_stream_error), and an unguarded read-modify-write here can
@@ -426,6 +449,16 @@ class FleetRouter:
         return sum(
             1 for r in self.replicas
             if r.serving and r.breaker.state != CLOSED
+        )
+
+    @property
+    def draining_count(self) -> int:
+        """Healthy replicas held out of new-stream placement by their
+        own draining flag (NOT quarantined: the breaker is closed and
+        in-flight streams keep running)."""
+        return sum(
+            1 for r in self.replicas
+            if r.serving and r.draining and r.breaker.state == CLOSED
         )
 
     def wait_live(self, min_live: int = 1,

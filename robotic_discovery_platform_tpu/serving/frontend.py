@@ -127,7 +127,23 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
         self.health.set(vision_grpc.SERVICE_NAME, health_lib.NOT_SERVING)
         router.on_membership = self._on_membership
         self.metrics_server: exposition.MetricsServer | None = None
+        # optional drift-triggered rollout supervisor (serving/rollout.py;
+        # duck-typed so this module stays jax-free): set via
+        # set_rollout_manager, stopped with the front-end, surfaced at
+        # GET /debug/rollout on the front-end's metrics endpoint
+        self.rollout = None
         self._closed = False
+
+    def set_rollout_manager(self, manager) -> None:
+        """Attach the rollout manager whose lifecycle this front-end
+        owns: /debug/rollout serves its snapshot, close() stops it."""
+        self.rollout = manager
+        if self.metrics_server is not None:
+            self.metrics_server.set_rollout_provider(
+                lambda: (self.rollout.snapshot()
+                         if self.rollout is not None
+                         else {"enabled": False,
+                               "reason": "no rollout manager attached"}))
 
     # -- membership-driven readiness ----------------------------------------
 
@@ -307,6 +323,12 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
     def close(self) -> None:
         self._closed = True
         self.health.set_all(health_lib.NOT_SERVING)
+        if self.rollout is not None:
+            try:
+                self.rollout.stop()
+            except Exception:  # pragma: no cover - teardown best-effort
+                log.exception("rollout manager stop failed")
+            self.rollout = None
         self.router.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()

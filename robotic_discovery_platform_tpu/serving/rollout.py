@@ -1,0 +1,985 @@
+"""Drift-triggered rollout: the state machine that turns the crank.
+
+PR 9 made drift a live signal (a ``RetrainRecommendation`` per sustained
+excursion) and PR 10 gave the fleet health-gated membership -- but the
+recommendation terminated in a log line. This module closes the loop:
+signal -> plan -> safe rollout, the third level of InferLine's
+planner/reactive split (PAPERS.md), with Clockwork-style predictability
+preserved by never letting training and serving contend for the same
+chips (training runs only on a replica the front-end has stopped placing
+streams on).
+
+The :class:`RolloutManager` drives one supervised lifecycle per accepted
+recommendation::
+
+    IDLE -> DRAINING -> RETRAINING -> SHADOW -> CANARY -> PROMOTING
+                                                        -> REJOINING -> IDLE
+
+- **DRAINING**: the least-loaded replica's ``draining`` flag goes up
+  (``VisionAnalysisService.set_draining``). The front-end stops placing
+  NEW streams there (serving/fleet.py treats ``draining=true`` as
+  unplaceable *before* health ever flips -- a graceful drain, not a
+  failover), in-flight streams finish normally, and the stage waits for
+  the replica's stream count to reach zero.
+- **RETRAINING**: ``workflows/retraining.run_retraining_pipeline`` runs
+  on the drained replica's mesh (``parallel/dp.py``), registering the
+  candidate under ``RolloutConfig.candidate_alias`` -- never under
+  ``staging``, so the serving alias cannot move before the gates pass.
+- **SHADOW**: the serving replicas mirror ``shadow_fraction`` of their
+  live frames to the candidate (a bounded queue the handler threads never
+  block on; candidate results are never returned to callers). Each
+  mirrored frame is diffed against the serving generation's own output:
+  mask IoU, |delta curvature|, and the five drift signals.
+- **CANARY**: the promotion gates are evaluated fail-closed -- the PR 8
+  parity fixtures (candidate vs the live generation over
+  ``quant.golden_frames``), the live shadow-diff deltas, and the
+  candidate-vs-serving drift scores. Every verdict is counted
+  (``rdp_rollout_gate_verdicts_total``); ANY failure rejects the
+  candidate.
+- **PROMOTING**: the registry ``staging`` alias moves to the candidate
+  and every replica promotes through the existing hot-reload
+  engine-generation swap -- which re-stamps the drift reference
+  ATOMICALLY with the engine (serving/server.py), so a mid-promotion
+  scrape never pairs new weights with the old reference.
+- **REJOINING**: the drained replica un-drains and rejoins the placement
+  ring on the front-end's next stats scrape.
+
+Every unhappy path -- retrain crash, gate failure, replica death
+mid-shadow, any stage exceeding its ``RolloutConfig`` timeout -- rolls
+back: the candidate is discarded, the replica un-drains, the fleet keeps
+serving the old generation, and the state machine lands in IDLE. The
+drift excursion re-arms only per the PR 9 hysteresis (recovery +
+cooldown), so a rolled-back cycle cannot machine-gun retraining.
+
+Every transition is counted (``rdp_rollout_transitions_total``), pinned
+in the flight recorder, and visible -- with per-stage timings, gate
+verdicts, and cycle history -- at ``GET /debug/rollout``. The clock and
+sleep are injectable, so the whole ladder is fake-clock testable like
+serving/controller.py and the drift monitor.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, NamedTuple, Sequence
+
+from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
+from robotic_discovery_platform_tpu.observability import (
+    instruments as obs,
+    recorder as recorder_lib,
+)
+from robotic_discovery_platform_tpu.utils.config import (
+    GeometryConfig,
+    RolloutConfig,
+    ServerConfig,
+)
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# -- states ------------------------------------------------------------------
+
+IDLE = "idle"
+DRAINING = "draining"
+RETRAINING = "retraining"
+SHADOW = "shadow"
+CANARY = "canary"
+PROMOTING = "promoting"
+REJOINING = "rejoining"
+
+#: every stage, in cycle order (the gauge publishes one label per state)
+STATES = (IDLE, DRAINING, RETRAINING, SHADOW, CANARY, PROMOTING, REJOINING)
+
+_ROLLOUT_ENV_VAR = "RDP_ROLLOUT"
+
+
+def resolve_rollout_enabled(configured: bool) -> bool:
+    """``RDP_ROLLOUT`` overrides ``RolloutConfig.enabled`` (1/true/on)."""
+    raw = os.environ.get(_ROLLOUT_ENV_VAR, "").strip().lower()
+    if not raw:
+        return bool(configured)
+    return raw in ("1", "true", "yes", "on")
+
+
+class StageError(RuntimeError):
+    """A rollout stage failed; ``stage`` names where the cycle died."""
+
+    def __init__(self, stage: str, message: str):
+        super().__init__(message)
+        self.stage = stage
+
+
+class StageTimeout(StageError):
+    """A rollout stage exceeded its RolloutConfig timeout."""
+
+
+# -- shadow mirroring --------------------------------------------------------
+
+
+class ShadowSample(NamedTuple):
+    """One live frame mirrored to the candidate: the decoded inputs plus
+    the serving generation's own outputs to diff against (the mask rides
+    along decoded -- re-decoding the response PNG per mirrored frame
+    would tax the shadow thread for nothing)."""
+
+    rgb: object
+    depth: object
+    k: object  # float32 intrinsics (the geometry cache's converted copy)
+    depth_scale: float
+    mask: object  # the live engine's binary mask (model-resolution)
+    coverage: float
+    mean_curvature: float
+    max_curvature: float
+    valid: bool
+    confidence_margin: float
+    depth_valid_fraction: float
+
+    def live_signals(self) -> dict[str, float]:
+        """The serving generation's drift-signal values for this frame
+        (same shape as profile_lib.frame_signals)."""
+        import math
+
+        return {
+            "mask_coverage": self.coverage,
+            "mean_curvature": (self.mean_curvature if self.valid
+                               else math.nan),
+            "max_curvature": (self.max_curvature if self.valid
+                              else math.nan),
+            "depth_valid_fraction": self.depth_valid_fraction,
+            "confidence_margin": self.confidence_margin,
+        }
+
+
+class ShadowRunner:
+    """Mirrors a fraction of live frames to the candidate and accumulates
+    the diff evidence the CANARY gates consume.
+
+    The ``hook`` side runs on serving handler threads and must never
+    block: it samples deterministically by fraction and does a
+    ``put_nowait`` into a bounded queue (overflow is dropped and
+    counted). The ``process`` side runs on the rollout cycle's own
+    thread: pop a sample, run the candidate analyzer, score the diff."""
+
+    def __init__(self, analyze: Callable, variables, *,
+                 fraction: float = 0.5, max_queue: int = 64):
+        self._analyze = analyze
+        self._variables = variables
+        self.fraction = min(max(float(fraction), 0.0), 1.0)
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(max_queue)))
+        self._lock = checked_lock("rollout.shadow")
+        self._seen = 0  # guarded_by: _lock
+        self._taken = 0  # guarded_by: _lock
+        self.mirrored = 0  # guarded_by: _lock
+        self.dropped = 0  # guarded_by: _lock
+        self.errors = 0
+        self.ious: list[float] = []
+        self.curv_errs: list[float] = []
+        self._live_signals: dict[str, list[float]] = {
+            name: [] for name in profile_lib.SERVING_SIGNALS
+        }
+        self._cand_signals: dict[str, list[float]] = {
+            name: [] for name in profile_lib.SERVING_SIGNALS
+        }
+
+    # -- handler-thread side -------------------------------------------------
+
+    def hook(self, sample: ShadowSample) -> None:
+        """The mirror tap the serving replicas call per analyzed frame."""
+        with self._lock:
+            self._seen += 1
+            take = self._seen * self.fraction >= self._taken + 1
+            if take:
+                self._taken += 1
+        if not take:
+            return
+        try:
+            self._q.put_nowait(sample)
+        except queue.Full:
+            with self._lock:
+                self.dropped += 1
+            obs.ROLLOUT_SHADOW_FRAMES.labels(outcome="dropped").inc()
+            return
+        with self._lock:
+            self.mirrored += 1
+        obs.ROLLOUT_SHADOW_FRAMES.labels(outcome="mirrored").inc()
+
+    # -- cycle-thread side ---------------------------------------------------
+
+    def process_one(self, timeout_s: float = 0.1) -> bool:
+        """Pop and diff one mirrored frame; False when none arrived
+        within ``timeout_s``."""
+        import math
+
+        import numpy as np
+
+        from robotic_discovery_platform_tpu.ops.pallas import quant
+
+        try:
+            sample = self._q.get(timeout=timeout_s)
+        except queue.Empty:
+            return False
+        try:
+            import jax
+
+            # explicit H2D for the mirrored inputs: the candidate
+            # analyzer runs under the transfer guard like every hot
+            # jitted entry, and implicit per-call transfers are exactly
+            # what RDP_TRANSFER_GUARD=strict refuses
+            inputs = jax.device_put((
+                sample.rgb, sample.depth, sample.k,
+                np.float32(sample.depth_scale),
+            ))
+            out = self._analyze(self._variables, *inputs)
+            cand_mask = np.asarray(out.mask)
+            cand_signals = profile_lib.frame_signals(out, sample.depth)
+        except Exception as exc:  # noqa: BLE001 - candidate bug = evidence
+            self.errors += 1
+            obs.ROLLOUT_SHADOW_FRAMES.labels(outcome="error").inc()
+            log.warning("shadow candidate failed on a mirrored frame "
+                        "(%s: %s)", type(exc).__name__, exc)
+            return True
+        self.ious.append(quant.mask_iou(sample.mask, cand_mask))
+        cand_valid = not math.isnan(cand_signals["mean_curvature"])
+        if sample.valid and cand_valid:
+            self.curv_errs.append(abs(
+                cand_signals["mean_curvature"] - sample.mean_curvature
+            ))
+        elif sample.valid != cand_valid:
+            # validity flip scored like quant.parity_report: the worst
+            # curvature outcome, visible to the gate
+            self.curv_errs.append(
+                abs(sample.mean_curvature if sample.valid
+                    else cand_signals["mean_curvature"])
+            )
+        live = sample.live_signals()
+        for name in self._live_signals:
+            lv, cv = live.get(name), cand_signals.get(name)
+            if lv is not None and math.isfinite(lv):
+                self._live_signals[name].append(lv)
+            if cv is not None and math.isfinite(cv):
+                self._cand_signals[name].append(cv)
+        obs.ROLLOUT_SHADOW_FRAMES.labels(outcome="diffed").inc()
+        return True
+
+    @property
+    def diffed(self) -> int:
+        return len(self.ious) + self.errors
+
+    def report(self) -> dict:
+        """The shadow evidence the gates evaluate: per-frame diff
+        aggregates plus the worst candidate-vs-serving PSI across the
+        drift signals (scored over the SAME mirrored frames, so the two
+        sides share their sampling noise)."""
+        import numpy as np
+
+        psi_by_signal: dict[str, float] = {}
+        for name, spec in profile_lib.SERVING_SIGNALS.items():
+            live = self._live_signals[name]
+            cand = self._cand_signals[name]
+            if len(live) < 2 or len(cand) < 2:
+                continue
+            score = profile_lib.score_value_lists(spec, live, cand)
+            psi_by_signal[name] = score.psi - score.noise_floor
+        with self._lock:
+            mirrored, dropped = self.mirrored, self.dropped
+        return {
+            "frames": len(self.ious),
+            "errors": self.errors,
+            "mirrored": mirrored,
+            "dropped": dropped,
+            "mask_iou_mean": (float(np.mean(self.ious))
+                              if self.ious else 0.0),
+            "mask_iou_min": (float(np.min(self.ious))
+                             if self.ious else 0.0),
+            "curvature_err_mean": (float(np.mean(self.curv_errs))
+                                   if self.curv_errs else 0.0),
+            "curvature_err_max": (float(np.max(self.curv_errs))
+                                  if self.curv_errs else 0.0),
+            "psi": psi_by_signal,
+            "psi_max": (max(psi_by_signal.values())
+                        if psi_by_signal else 0.0),
+        }
+
+
+# -- targets -----------------------------------------------------------------
+
+
+class RolloutTarget:
+    """The rollout control surface over one in-process replica servicer
+    (serving/server.VisionAnalysisService). Duck-typed on purpose: tests
+    drive the manager with fakes exposing the same six members, and a
+    future remote-target can speak RPC behind the identical surface."""
+
+    def __init__(self, name: str, servicer):
+        self.name = name
+        self.servicer = servicer
+
+    @property
+    def active_streams(self) -> int:
+        return self.servicer.active_streams
+
+    @property
+    def draining(self) -> bool:
+        return self.servicer.is_draining
+
+    @property
+    def current_version(self):
+        return self.servicer.current_version
+
+    def set_draining(self, draining: bool) -> None:
+        self.servicer.set_draining(draining)
+
+    def set_shadow(self, hook) -> None:
+        self.servicer.set_shadow(hook)
+
+    def promote(self) -> bool:
+        """Drive one hot-reload check NOW (the poller would get there on
+        its own tick; promotion should not wait for it)."""
+        return bool(self.servicer.maybe_reload())
+
+    def reference_analyzer(self):
+        """An f32 analyzer over the CURRENT generation's pristine pair --
+        the fixture gate's reference side. The weight tree is staged
+        explicitly (a registry-loaded tree surfaces as host numpy, and
+        implicit per-call re-transfers are what the transfer guard
+        refuses); the servicer's own pair is never mutated."""
+        import jax
+
+        from robotic_discovery_platform_tpu.ops import pipeline
+
+        model, variables = self.servicer._pristine
+        if any(not isinstance(leaf, jax.Array)
+               for leaf in jax.tree_util.tree_leaves(variables)):
+            variables = jax.device_put(variables)
+        cfg = self.servicer.cfg
+        analyze = pipeline.make_frame_analyzer(
+            model, img_size=cfg.model_img_size,
+            geom_cfg=self.servicer.geom_cfg,
+        )
+        return lambda rgb, depth, k, scale: analyze(
+            variables, rgb, depth, k, scale)
+
+    def training_mesh(self):
+        """The drained replica's device mesh for the retraining run
+        (parallel/dp.py); None when no mesh is buildable (single-device
+        CPU smoke trains unmeshed)."""
+        try:
+            from robotic_discovery_platform_tpu.parallel import (
+                mesh as mesh_lib,
+            )
+
+            chips = max(1, getattr(self.servicer, "serving_chips", 1))
+            return mesh_lib.make_serving_mesh(chips)
+        except Exception as exc:  # noqa: BLE001 - mesh is best-effort
+            log.warning("no training mesh for %s (%s: %s); retraining "
+                        "runs unmeshed", self.name, type(exc).__name__,
+                        exc)
+            return None
+
+
+# -- gates -------------------------------------------------------------------
+
+
+def evaluate_gates(cfg: RolloutConfig, fixture_report: dict,
+                   shadow_report: dict) -> tuple[bool, dict]:
+    """Fail-closed promotion verdict: every gate must pass. Returns
+    ``(passed, verdicts)`` where ``verdicts`` maps gate name to
+    ``{"value", "threshold", "pass"}``; each verdict is also counted in
+    ``rdp_rollout_gate_verdicts_total``."""
+    verdicts = {
+        "fixture_iou": {
+            "value": fixture_report["mask_iou_mean"],
+            "threshold": cfg.gate_fixture_min_iou,
+            "pass": (fixture_report["mask_iou_mean"]
+                     >= cfg.gate_fixture_min_iou),
+        },
+        "fixture_curv": {
+            "value": fixture_report["curvature_err_max"],
+            "threshold": cfg.gate_fixture_max_curv_err,
+            "pass": (fixture_report["curvature_err_max"]
+                     <= cfg.gate_fixture_max_curv_err),
+        },
+        "shadow_frames": {
+            "value": shadow_report["frames"],
+            "threshold": cfg.shadow_min_frames,
+            "pass": shadow_report["frames"] >= cfg.shadow_min_frames,
+        },
+        "shadow_iou": {
+            "value": shadow_report["mask_iou_mean"],
+            "threshold": cfg.gate_shadow_min_iou,
+            "pass": (shadow_report["mask_iou_mean"]
+                     >= cfg.gate_shadow_min_iou),
+        },
+        "shadow_curv": {
+            "value": shadow_report["curvature_err_max"],
+            "threshold": cfg.gate_shadow_max_curv_err,
+            "pass": (shadow_report["curvature_err_max"]
+                     <= cfg.gate_shadow_max_curv_err),
+        },
+        "shadow_psi": {
+            "value": shadow_report["psi_max"],
+            "threshold": cfg.gate_shadow_max_psi,
+            "pass": shadow_report["psi_max"] <= cfg.gate_shadow_max_psi,
+        },
+    }
+    for gate, v in verdicts.items():
+        obs.ROLLOUT_GATE_VERDICTS.labels(
+            gate=gate, verdict="pass" if v["pass"] else "fail"
+        ).inc()
+    return all(v["pass"] for v in verdicts.values()), verdicts
+
+
+# -- the manager -------------------------------------------------------------
+
+
+class RolloutManager:
+    """Consumes retrain recommendations and drives the drain -> retrain
+    -> shadow -> gate -> promote/rollback cycle over a set of
+    :class:`RolloutTarget`-shaped replicas.
+
+    ``train_fn(target) -> PipelineResult`` is injectable (tests and the
+    smoke harness register crafted candidates); the default runs the real
+    ``workflows/retraining`` pipeline on the drained target's mesh with
+    the ``train_cfg``/``model_cfg`` given at construction. ``clock`` and
+    ``sleep`` are injectable for fake-clock tests. ``run_cycle`` is
+    public and synchronous so tests drive the ladder deterministically;
+    ``start()`` adds the worker thread that services live
+    recommendations."""
+
+    #: completed cycles kept for /debug/rollout
+    HISTORY = 16
+
+    def __init__(
+        self,
+        targets: Sequence,
+        cfg: RolloutConfig = RolloutConfig(),
+        server_cfg: ServerConfig = ServerConfig(),
+        *,
+        train_fn: Callable | None = None,
+        train_cfg=None,
+        model_cfg=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.targets = list(targets)
+        self.cfg = cfg
+        self.server_cfg = server_cfg
+        self._train_fn = train_fn
+        self._train_cfg = train_cfg
+        self._model_cfg = model_cfg
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = checked_lock("rollout.manager")
+        self._state = IDLE  # guarded_by: _lock
+        self._current: dict | None = None  # guarded_by: _lock
+        self.history: list[dict] = []  # guarded_by: _lock
+        self._cycles = 0  # guarded_by: _lock
+        self._inbox: queue.Queue = queue.Queue(maxsize=1)
+        self._stop: threading.Event | None = None
+        self._thread: threading.Thread | None = None
+        self._publish_state(IDLE)
+
+    # -- wiring --------------------------------------------------------------
+
+    def add_target(self, target) -> None:
+        self.targets.append(target)
+
+    def on_recommendation(self, rec) -> bool:
+        """The drift monitor's callback (serving/server.py forwards it).
+        Non-blocking: enqueues the recommendation for the worker when the
+        machine is idle, else counts it skipped -- at most one cycle runs
+        at a time, and the PR 9 hysteresis already throttles the stream
+        to one recommendation per excursion."""
+        with self._lock:
+            busy = self._state != IDLE
+        if busy:
+            obs.ROLLOUT_SKIPPED.labels(reason="busy").inc()
+            log.info("rollout busy (%s); recommendation skipped",
+                     self.state)
+            return False
+        try:
+            self._inbox.put_nowait(rec)
+        except queue.Full:
+            obs.ROLLOUT_SKIPPED.labels(reason="busy").inc()
+            return False
+        return True
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    rec = self._inbox.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if rec is None:
+                    return
+                try:
+                    self.run_cycle(rec)
+                except Exception:  # pragma: no cover - cycle self-guards
+                    log.exception("rollout cycle crashed")
+
+        self._thread = threading.Thread(target=loop, name="rollout-manager",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+            try:
+                self._inbox.put_nowait(None)
+            except queue.Full:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _publish_state(self, state: str) -> None:
+        for s in STATES:
+            obs.ROLLOUT_STATE.labels(state=s).set(1.0 if s == state else 0.0)
+
+    def _transition(self, to: str, cycle: dict | None = None,
+                    **labels) -> None:
+        with self._lock:
+            frm, self._state = self._state, to
+            if cycle is not None:
+                cycle["stages"].append(
+                    {"stage": to, "at_s": round(self._clock(), 3)})
+        self._publish_state(to)
+        obs.ROLLOUT_TRANSITIONS.labels(to=to).inc()
+        # pinned: a rollout transition is promotion-audit evidence that
+        # must survive ring wrap-around
+        recorder_lib.RECORDER.pin(recorder_lib.RECORDER.record_event(
+            "serving.rollout.transition", frm=frm, to=to,
+            **{k: str(v) for k, v in labels.items()},
+        ))
+        log.info("rollout: %s -> %s%s", frm, to,
+                 f" {labels}" if labels else "")
+
+    # -- the cycle -----------------------------------------------------------
+
+    def _pick_target(self):
+        """Least-loaded drainable replica -- ONLY when at least one other
+        replica keeps serving (the loop never trades availability for
+        freshness)."""
+        candidates = [t for t in self.targets
+                      if not getattr(t, "draining", False)]
+        if len(candidates) < 2:
+            return None
+        return min(candidates, key=lambda t: t.active_streams)
+
+    def _wait(self, stage: str, deadline: float, done: Callable[[], bool],
+              what: str) -> None:
+        while not done():
+            if self._clock() >= deadline:
+                raise StageTimeout(stage, f"{stage}: timed out waiting "
+                                          f"for {what}")
+            self._sleep(0.05)
+
+    def _retrain(self, target) -> object:
+        """Run the training function bounded by the stage timeout. The
+        thread cannot be killed mid-train; on timeout its eventual result
+        is discarded (the cycle has moved on and the candidate alias is
+        never promoted)."""
+        result_box: list = []
+
+        def run():
+            try:
+                result_box.append(self._train(target))
+            except Exception as exc:  # noqa: BLE001 - surfaced below
+                result_box.append(exc)
+
+        t = threading.Thread(target=run, name="rollout-retrain",
+                             daemon=True)
+        t.start()
+        deadline = self._clock() + self.cfg.retrain_timeout_s
+        while t.is_alive():
+            if self._clock() >= deadline:
+                raise StageTimeout(
+                    RETRAINING,
+                    f"retraining exceeded {self.cfg.retrain_timeout_s:.0f}s"
+                    "; candidate (if any) is discarded")
+            t.join(timeout=0.05)
+            if t.is_alive():
+                # the injectable sleep is what advances a fake clock --
+                # join() alone would spin a fake-clock test forever
+                self._sleep(0.05)
+        if not result_box:
+            raise StageError(RETRAINING, "retraining returned nothing")
+        result = result_box[0]
+        if isinstance(result, BaseException):
+            raise StageError(
+                RETRAINING,
+                f"retraining raised {type(result).__name__}: {result}")
+        return result
+
+    def _train(self, target):
+        if self._train_fn is not None:
+            return self._train_fn(target)
+        if self._train_cfg is None:
+            raise StageError(
+                RETRAINING,
+                "no train_fn and no train_cfg configured; the rollout "
+                "manager cannot launch the retraining pipeline")
+        from robotic_discovery_platform_tpu.workflows.retraining import (
+            run_retraining_pipeline,
+        )
+
+        mesh = target.training_mesh() if hasattr(target, "training_mesh") \
+            else None
+        kwargs = {"mesh": mesh, "alias": self.cfg.candidate_alias}
+        if self._model_cfg is not None:
+            kwargs["model_cfg"] = self._model_cfg
+        return run_retraining_pipeline(self._train_cfg, **kwargs)
+
+    def _load_candidate(self, version):
+        """The candidate analyzer + variables for shadow/fixture runs.
+        The weight tree is staged explicitly ONCE (serving/server.py's
+        _make_engine policy): a registry-loaded tree is host numpy, and
+        passing it raw would re-transfer every weight per mirrored frame
+        -- implicitly, which RDP_TRANSFER_GUARD=strict rightly refuses."""
+        import jax
+
+        from robotic_discovery_platform_tpu import tracking
+        from robotic_discovery_platform_tpu.ops import pipeline
+
+        store = tracking.store_for(self.server_cfg.tracking_uri)
+        model, variables = tracking.load_model(
+            f"models:/{self.server_cfg.model_name}/{version}", store=store,
+        )
+        if any(not isinstance(leaf, jax.Array)
+               for leaf in jax.tree_util.tree_leaves(variables)):
+            variables = jax.device_put(variables)
+        analyze = pipeline.make_frame_analyzer(
+            model, img_size=self.server_cfg.model_img_size,
+            geom_cfg=GeometryConfig(stride=self.server_cfg.geometry_stride),
+        )
+        return analyze, variables
+
+    #: the fixture scenes' camera geometry -- also the candidate warm
+    #: shape (a mirrored frame of the same geometry reuses the compile)
+    FIXTURE_H, FIXTURE_W = 120, 160
+
+    def _warm_candidate(self, cand_analyze, cand_variables) -> None:
+        """One golden frame through the candidate so its graph is
+        compiled before shadow mirroring starts. Best-effort: a failure
+        here will resurface as shadow-frame errors the gate sees."""
+        import jax
+        import numpy as np
+
+        from robotic_discovery_platform_tpu.ops.pallas import quant
+
+        h, w = self.FIXTURE_H, self.FIXTURE_W
+        f = 0.94 * w
+        k = np.array([[f, 0, w / 2], [0, f, h / 2], [0, 0, 1]], np.float32)
+        try:
+            rgb, depth = quant.golden_frames(1, h, w)[0]
+            inputs = jax.device_put((
+                rgb, depth, k,
+                np.float32(self.server_cfg.default_depth_scale),
+            ))
+            cand_analyze(cand_variables, *inputs)
+        except Exception as exc:  # noqa: BLE001 - surfaced by the gates
+            log.warning("candidate warm-up failed (%s: %s); the shadow "
+                        "stage will surface it", type(exc).__name__, exc)
+
+    def _fixture_report(self, reference_analyzer, cand_analyze,
+                        cand_variables) -> dict:
+        """The PR 8 parity fixtures, candidate vs the live generation:
+        deterministic synthetic scenes through both analyzers, scored by
+        quant.parity_report."""
+        import jax
+        import numpy as np
+
+        from robotic_discovery_platform_tpu.ops.pallas import quant
+
+        h, w = self.FIXTURE_H, self.FIXTURE_W
+        f = 0.94 * w
+        k = np.array([[f, 0, w / 2], [0, f, h / 2], [0, 0, 1]], np.float32)
+        scale = np.float32(self.server_cfg.default_depth_scale)
+        refs, gots = [], []
+        for rgb, depth in quant.golden_frames(
+            self.cfg.gate_fixture_frames, h, w
+        ):
+            # explicit H2D per fixture frame (transfer-guard discipline)
+            inputs = jax.device_put((rgb, depth, k, scale))
+            refs.append(reference_analyzer(*inputs))
+            gots.append(cand_analyze(cand_variables, *inputs))
+        return quant.parity_report(refs, gots)
+
+    def _promote(self, cycle: dict, version) -> None:
+        """Move the staging alias and drive every replica through its
+        hot-reload swap; on partial failure the alias is restored and the
+        already-promoted replicas are reloaded back -- fail-closed, the
+        fleet converges on ONE generation either way."""
+        from robotic_discovery_platform_tpu import tracking
+
+        store = tracking.store_for(self.server_cfg.tracking_uri)
+        name = self.server_cfg.model_name
+        previous = store.get_alias(name, self.server_cfg.model_alias)
+        cycle["previous_version"] = previous
+        store.set_alias(name, self.server_cfg.model_alias, int(version))
+        try:
+            deadline = self._clock() + self.cfg.promote_timeout_s
+            for t in self.targets:
+                t.promote()
+                self._wait(
+                    PROMOTING, deadline,
+                    lambda t=t: t.current_version == int(version),
+                    f"replica {t.name} to adopt version {version}",
+                )
+        except Exception:
+            if previous is not None:
+                log.error("promotion failed mid-swap; reverting %s alias "
+                          "to version %s", self.server_cfg.model_alias,
+                          previous)
+                store.set_alias(name, self.server_cfg.model_alias,
+                                int(previous))
+                for t in self.targets:
+                    try:
+                        t.promote()
+                    except Exception:  # noqa: BLE001 - best-effort revert
+                        log.exception("revert reload failed on %s", t.name)
+            raise
+
+    def run_cycle(self, rec) -> dict:
+        """One full supervised rollout for ``rec``; returns the cycle
+        record (also appended to :attr:`history`). Never raises: every
+        failure is a recorded rollback landing back in IDLE."""
+        t0 = self._clock()
+        cycle: dict = {
+            "reason": getattr(rec, "reason", str(rec)),
+            "signals": list(getattr(rec, "signals", []) or []),
+            "started_s": round(t0, 3),
+            "stages": [],
+            "outcome": None,
+            "candidate_version": None,
+            "gates": None,
+            "shadow": None,
+            "fixture": None,
+        }
+        with self._lock:
+            self._current = cycle
+        target = self._pick_target()
+        if target is None:
+            obs.ROLLOUT_SKIPPED.labels(reason="no_spare_replica").inc()
+            cycle["outcome"] = "skipped"
+            cycle["error"] = ("no spare replica: draining one would leave "
+                              "nothing serving")
+            log.warning("rollout skipped: %s", cycle["error"])
+            self._record_cycle(cycle, t0)
+            return cycle
+        cycle["replica"] = target.name
+        stage = DRAINING
+        drained = False
+        try:
+            # -- DRAINING --------------------------------------------------
+            self._transition(DRAINING, cycle, replica=target.name)
+            target.set_draining(True)
+            drained = True
+            self._wait(DRAINING, self._clock() + self.cfg.drain_timeout_s,
+                       lambda: target.active_streams == 0,
+                       "in-flight streams to finish")
+
+            # -- RETRAINING ------------------------------------------------
+            stage = RETRAINING
+            self._transition(RETRAINING, cycle, replica=target.name)
+            result = self._retrain(target)
+            if result is None or not getattr(result, "succeeded", False) \
+                    or getattr(result, "version", None) is None:
+                raise StageError(
+                    RETRAINING,
+                    "retraining pipeline failed: "
+                    f"{getattr(result, 'message', result)}")
+            version = int(result.version)
+            cycle["candidate_version"] = version
+            cand_analyze, cand_variables = self._load_candidate(version)
+            # warm the candidate's graph BEFORE the shadow stage opens
+            # (the server's own discipline: compile off the measured
+            # path). Without this the first mirrored frame pays the full
+            # XLA compilation inside the shadow stage's budget.
+            self._warm_candidate(cand_analyze, cand_variables)
+
+            # -- SHADOW ----------------------------------------------------
+            stage = SHADOW
+            self._transition(SHADOW, cycle, candidate=version)
+            runner = ShadowRunner(
+                cand_analyze, cand_variables,
+                fraction=self.cfg.shadow_fraction,
+                max_queue=self.cfg.shadow_queue,
+            )
+            live_targets = [t for t in self.targets if t is not target]
+            for t in live_targets:
+                t.set_shadow(runner.hook)
+            try:
+                deadline = self._clock() + self.cfg.shadow_timeout_s
+                while runner.diffed < self.cfg.shadow_min_frames:
+                    if self._clock() >= deadline:
+                        break
+                    if not runner.process_one(timeout_s=0.0):
+                        # idle tap: wait through the injectable sleep so
+                        # fake-clock tests can expire the stage
+                        self._sleep(0.05)
+                # drain what was already mirrored before the tap closes
+                while runner.process_one(timeout_s=0.0):
+                    pass
+            finally:
+                for t in live_targets:
+                    try:
+                        t.set_shadow(None)
+                    except Exception:  # noqa: BLE001 - replica died
+                        log.exception("clearing shadow tap on %s failed",
+                                      t.name)
+            shadow_report = runner.report()
+            cycle["shadow"] = shadow_report
+
+            # -- CANARY ----------------------------------------------------
+            stage = CANARY
+            self._transition(CANARY, cycle, candidate=version)
+            reference = None
+            for t in live_targets:
+                try:
+                    reference = t.reference_analyzer()
+                    break
+                except Exception:  # noqa: BLE001 - try the next replica
+                    log.exception("reference analyzer from %s failed",
+                                  t.name)
+            if reference is None:
+                raise StageError(CANARY, "no live replica could provide "
+                                         "the fixture reference analyzer")
+            fixture_report = self._fixture_report(
+                reference, cand_analyze, cand_variables)
+            cycle["fixture"] = fixture_report
+            passed, verdicts = evaluate_gates(
+                self.cfg, fixture_report, shadow_report)
+            cycle["gates"] = verdicts
+            if not passed:
+                failed = sorted(g for g, v in verdicts.items()
+                                if not v["pass"])
+                raise StageError(
+                    CANARY,
+                    f"candidate v{version} rejected by gate(s) "
+                    f"{', '.join(failed)}")
+
+            # -- PROMOTING -------------------------------------------------
+            stage = PROMOTING
+            self._transition(PROMOTING, cycle, candidate=version)
+            self._promote(cycle, version)
+
+            # -- REJOINING -------------------------------------------------
+            stage = REJOINING
+            self._transition(REJOINING, cycle, replica=target.name)
+            target.set_draining(False)
+            drained = False
+            cycle["outcome"] = "promoted"
+            obs.ROLLOUT_CYCLES.labels(outcome="promoted").inc()
+            log.info("rollout promoted version %s (replica %s rejoining)",
+                     version, target.name)
+        except Exception as exc:  # noqa: BLE001 - every failure rolls back
+            failed_stage = exc.stage if isinstance(exc, StageError) \
+                else stage
+            cycle["outcome"] = "rolled_back"
+            cycle["rolled_back_at"] = failed_stage
+            cycle["error"] = f"{type(exc).__name__}: {exc}"
+            obs.ROLLOUT_ROLLBACKS.labels(stage=failed_stage).inc()
+            obs.ROLLOUT_CYCLES.labels(outcome="rolled_back").inc()
+            recorder_lib.RECORDER.pin(recorder_lib.RECORDER.record_event(
+                "serving.rollout.rollback", stage=failed_stage,
+                error=cycle["error"],
+            ))
+            log.warning(
+                "rollout ROLLBACK at %s: %s -- candidate discarded, fleet "
+                "keeps serving the old generation", failed_stage,
+                cycle["error"],
+            )
+            if drained:
+                # the replica must never stay stuck draining
+                self._transition(REJOINING, cycle, replica=target.name)
+                try:
+                    target.set_draining(False)
+                except Exception:  # noqa: BLE001 - replica died entirely
+                    log.exception("un-drain of %s failed; the membership "
+                                  "poll owns its fate now", target.name)
+        finally:
+            self._record_cycle(cycle, t0)
+        return cycle
+
+    def _record_cycle(self, cycle: dict, t0: float) -> None:
+        cycle["duration_s"] = round(self._clock() - t0, 3)
+        with self._lock:
+            self._cycles += 1
+            self._current = None
+            self.history.append(cycle)
+            del self.history[:-self.HISTORY]
+            already_idle = self._state == IDLE
+        if not already_idle:
+            self._transition(IDLE)
+
+    # -- /debug/rollout ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": True,
+                "state": self._state,
+                "cycles_total": self._cycles,
+                "current": dict(self._current) if self._current else None,
+                "replicas": [
+                    {
+                        "name": t.name,
+                        "active_streams": t.active_streams,
+                        "version": t.current_version,
+                    }
+                    for t in self.targets
+                ],
+                "config": {
+                    "shadow_fraction": self.cfg.shadow_fraction,
+                    "shadow_min_frames": self.cfg.shadow_min_frames,
+                    "candidate_alias": self.cfg.candidate_alias,
+                    "gates": {
+                        "fixture_min_iou": self.cfg.gate_fixture_min_iou,
+                        "fixture_max_curv_err":
+                            self.cfg.gate_fixture_max_curv_err,
+                        "shadow_min_iou": self.cfg.gate_shadow_min_iou,
+                        "shadow_max_curv_err":
+                            self.cfg.gate_shadow_max_curv_err,
+                        "shadow_max_psi": self.cfg.gate_shadow_max_psi,
+                    },
+                    "timeouts_s": {
+                        "drain": self.cfg.drain_timeout_s,
+                        "retrain": self.cfg.retrain_timeout_s,
+                        "shadow": self.cfg.shadow_timeout_s,
+                        "promote": self.cfg.promote_timeout_s,
+                    },
+                },
+                "history": list(self.history),
+            }
+
+
+def attach_rollout(manager: RolloutManager, servicers,
+                   names: Sequence[str] | None = None) -> list[RolloutTarget]:
+    """Wire in-process replica servicers to one shared manager: each
+    becomes a :class:`RolloutTarget`, and each servicer's drift
+    recommendations feed :meth:`RolloutManager.on_recommendation`."""
+    targets = []
+    for i, servicer in enumerate(servicers):
+        name = names[i] if names is not None else f"replica-{i}"
+        target = RolloutTarget(name, servicer)
+        manager.add_target(target)
+        servicer.rollout = manager
+        targets.append(target)
+    return targets

@@ -141,6 +141,7 @@ from robotic_discovery_platform_tpu.serving import (
     fleet as fleet_lib,
     health as health_lib,
     ingest as ingest_lib,
+    rollout as rollout_lib,
 )
 from robotic_discovery_platform_tpu.ops.pallas import quant
 from robotic_discovery_platform_tpu.serving.batching import (
@@ -386,6 +387,14 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self._streams_cond = threading.Condition()
         self._active_streams = 0  # guarded_by: _streams_cond
         self._draining = False  # guarded_by: _streams_cond
+        # Rollout wiring (serving/rollout.py): the shadow tap mirrors a
+        # fraction of analyzed frames (inputs + this generation's
+        # outputs) to a gated candidate -- installed/cleared by the
+        # rollout manager for the SHADOW stage, a single attribute read
+        # per frame otherwise. `rollout` is the shared RolloutManager
+        # drift recommendations are forwarded to when one is attached.
+        self._shadow_hook = None
+        self.rollout: rollout_lib.RolloutManager | None = None
         # frames served over this process's lifetime (every terminal
         # status); reported over the replica stats RPC so a fleet
         # front-end can read per-replica progress without scraping
@@ -535,8 +544,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             self, rec: profile_lib.RetrainRecommendation) -> None:
         """Hysteresis-gated: at most one of these per sustained excursion.
         Counted, pinned in the flight recorder (a recommendation is
-        evidence that must survive ring wrap-around), and logged -- PR
-        10's trigger wiring consumes the same structured object."""
+        evidence that must survive ring wrap-around), logged -- and, when
+        a rollout manager is attached (serving/rollout.py), handed to it:
+        the recommendation becomes a supervised drain -> retrain ->
+        shadow -> gate -> promote cycle instead of terminating here."""
         obs.DRIFT_RECOMMENDATIONS.inc()
         recorder_lib.RECORDER.pin(recorder_lib.RECORDER.record_event(
             "serving.drift_recommendation",
@@ -549,15 +560,25 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             "DRIFT: %s -- recommend retraining (workflows.retraining)",
             rec.reason,
         )
+        manager = self.rollout
+        if manager is not None:
+            try:
+                manager.on_recommendation(rec)
+            except Exception:  # pragma: no cover - manager bug
+                log.exception("rollout manager rejected the "
+                              "recommendation")
 
-    def _rebaseline_drift(self, version: int | None) -> None:
-        """Hot-reload hook: the swapped-in generation gets its own
-        reference -- the new version's profile artifact when it shipped
-        one, else a fresh self-baseline -- re-stamping the reference
-        generation either way."""
+    def _apply_drift_reference(
+            self, version: int | None,
+            reference: profile_lib.FeatureProfile | None) -> None:
+        """Adopt the swapped-in generation's drift reference -- its
+        profile artifact when it shipped one, else a fresh self-baseline,
+        re-stamping the reference generation either way. Callers hold
+        ``_reload_lock``: the reference must change in the SAME critical
+        section as the engine swap, so a scrape can never pair new
+        weights with the old reference (or vice versa)."""
         if self.drift is None:
             return
-        reference = self._load_drift_profile(version)
         if reference is not None:
             self.drift.set_reference(reference)
             obs.DRIFT_REFERENCE_AGE.set(reference.age_s)
@@ -565,14 +586,32 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             self.drift.rebaseline(generation=version)
             obs.DRIFT_REFERENCE_AGE.set(-1.0)
 
+    def version_and_reference(self) -> tuple[int | None, object]:
+        """The (engine generation, drift reference generation) pair read
+        under the reload lock -- the consistency the promotion swap
+        guarantees: both move together, so this never returns a mixed
+        pair (tests and /debug consumers assert it)."""
+        with self._reload_lock:
+            version = self._engine.version
+            if self.drift is None:
+                return version, None
+            ref = self.drift.reference
+            gen = (ref.generation if ref is not None
+                   and ref.generation is not None
+                   else self.drift.generation)
+            return version, gen
+
     def drift_debug(self) -> dict:
-        """The ``GET /debug/drift`` payload."""
+        """The ``GET /debug/drift`` payload. Snapshot and engine version
+        are read under the reload lock so a mid-promotion request sees a
+        consistent (weights, reference) pair."""
         if self.drift is None:
             return {"enabled": False,
                     "reason": "drift monitoring disabled "
                               "(ServerConfig.drift_enabled)"}
-        snap = self.drift.snapshot()
-        snap["model_version"] = self.current_version
+        with self._reload_lock:
+            snap = self.drift.snapshot()
+            snap["model_version"] = self._engine.version
         return snap
 
     @property
@@ -808,8 +847,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             ok, mask_png = cv2.imencode(".png", mask * 255)
         if not ok:
             raise ValueError("mask encode failed")
-        return _FrameResult(mean_k, max_k, spline, mask_png.tobytes(),
-                            coverage, valid, margin, depth_valid)
+        res = _FrameResult(mean_k, max_k, spline, mask_png.tobytes(),
+                           coverage, valid, margin, depth_valid)
+        self._mirror_shadow(rgb, depth, geom.k_f32, mask, res)
+        return res
 
     def _observe_drift(self, res: _FrameResult) -> None:
         """Feed one analyzed frame's signals to the drift monitor and the
@@ -854,6 +895,64 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         with self._streams_cond:
             return self._active_streams
 
+    @property
+    def is_draining(self) -> bool:
+        with self._streams_cond:
+            return self._draining
+
+    def set_draining(self, draining: bool) -> None:
+        """Rollout drain control: flip ONLY the draining flag. Unlike
+        :meth:`drain` (the shutdown path), health stays SERVING -- the
+        fleet front-end reads ``draining`` off the stats RPC and stops
+        placing NEW streams here while in-flight streams finish normally
+        (graceful drain, not failover), and ``set_draining(False)``
+        reverses it (rollback / rejoin). New direct-dial streams are
+        refused UNAVAILABLE meanwhile, exactly like a shutdown drain.
+        A closed service cannot be un-drained."""
+        draining = bool(draining)
+        with self._streams_cond:
+            if self._closed and not draining:
+                return
+            changed = self._draining != draining
+            self._draining = draining
+            self._streams_cond.notify_all()
+        if changed:
+            log.info(
+                "replica %s: %s new streams (health stays up)",
+                "draining" if draining else "un-draining",
+                "refusing" if draining else "accepting",
+            )
+
+    def set_shadow(self, hook) -> None:
+        """Install (or clear with ``None``) the rollout shadow tap: a
+        callable receiving one :class:`~robotic_discovery_platform_tpu.
+        serving.rollout.ShadowSample` per analyzed frame. The hook is
+        invoked on the handler thread AFTER the response is computed and
+        must never block (the rollout ShadowRunner's hook samples and
+        ``put_nowait``s)."""
+        self._shadow_hook = hook
+
+    def _mirror_shadow(self, rgb, depth, k, mask,
+                       res: _FrameResult) -> None:
+        """One attribute read per frame when no tap is installed; with a
+        tap, hand the frame's inputs + this generation's outputs to the
+        rollout shadow. A hook failure never fails the frame."""
+        hook = self._shadow_hook
+        if hook is None:
+            return
+        try:
+            hook(rollout_lib.ShadowSample(
+                rgb=rgb, depth=depth, k=np.asarray(k),
+                depth_scale=self.depth_scale, mask=mask,
+                coverage=res.coverage, mean_curvature=res.mean_k,
+                max_curvature=res.max_k, valid=res.valid,
+                confidence_margin=res.confidence_margin,
+                depth_valid_fraction=res.depth_valid_fraction,
+            ))
+        except Exception:  # noqa: BLE001 - shadow must not fail serving
+            log.exception("shadow mirror hook failed; frame served "
+                          "normally")
+
     def replica_stats(self) -> dict:
         """The lightweight per-replica stats payload the fleet front-end
         scrapes over gRPC (serving/fleet.add_replica_stats_to_server):
@@ -862,6 +961,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         a fleet dashboard wants next to them."""
         eng = self._engine
         router = eng.dispatcher.router if eng.dispatcher is not None else None
+        # version + drift reference generation as ONE consistent pair
+        # (read under the reload lock): a scrape racing a promotion sees
+        # either the old pair or the new pair, never a mix
+        version, drift_generation = self.version_and_reference()
         return {
             "inflight_streams": self.active_streams,
             "frames_total": self._frames_total,
@@ -870,8 +973,9 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             "chips": self.serving_chips,
             "quarantined_chips": (len(router.quarantined)
                                   if router is not None else 0),
-            "version": self.current_version,
-            "draining": self._draining,
+            "version": version,
+            "drift_generation": drift_generation,
+            "draining": self.is_draining,
             "refusing_streams": self._refusing_streams,
             "pid": os.getpid(),
         }
@@ -1087,6 +1191,13 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 store=self._registry_store,
             )
             engine = self._make_engine(model, variables, version)
+            # the new generation's drift reference is RESOLVED here
+            # (registry I/O, off-lock) but ADOPTED inside the swap's
+            # critical section below: engine generation and drift
+            # reference move atomically, so a concurrent scrape never
+            # pairs new weights with the old reference
+            drift_reference = (self._load_drift_profile(version)
+                               if self.drift is not None else None)
             if self._closed:
                 return False  # skip the warm entirely; finally cleans up
             # compile + run every graph live frames will hit, off the
@@ -1112,6 +1223,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                         continue  # warmup() raced us; warm the new shape
                     old, self._engine = self._engine, engine
                     engine = None  # went live; finally must not stop it
+                    # same critical section as the engine swap: the new
+                    # generation's reference (artifact or re-baseline)
+                    # goes live with its weights, never after them
+                    self._apply_drift_reference(version, drift_reference)
                     if old.dispatcher is not None:
                         # Grace-delayed stop: a frame thread that read the
                         # OLD engine just before the swap may still be
@@ -1134,11 +1249,6 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     break
             log.info("hot-reloaded model: version %s -> %s",
                      old.version, version)
-            # the new generation gets its own drift reference (its
-            # profile artifact, or a fresh self-baseline): live-window
-            # scores against the OLD model's reference say nothing about
-            # the model now serving
-            self._rebaseline_drift(version)
             return True
         finally:
             # never went live (error, closed mid-build/-warm, or the swap
@@ -1390,6 +1500,17 @@ def build_server(
         # /debug/drift serves the monitor's live state (histograms,
         # scores, recommendation ladder) next to /debug/spans
         servicer.metrics_server.set_drift_provider(servicer.drift_debug)
+        # /debug/rollout resolves the manager per request, so attaching
+        # one after boot (rollout_lib.attach_rollout) makes the endpoint
+        # live without re-wiring
+        servicer.metrics_server.set_rollout_provider(
+            lambda: (servicer.rollout.snapshot()
+                     if servicer.rollout is not None
+                     else {"enabled": False,
+                           "reason": "no rollout manager attached "
+                                     "(RolloutConfig.enabled / "
+                                     "RDP_ROLLOUT)"})
+        )
     if warmup_shape is not None:
         servicer.warmup(*warmup_shape)  # flips readiness at the end
     else:

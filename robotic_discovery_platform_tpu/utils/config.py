@@ -422,6 +422,65 @@ class ServerConfig:
 
 
 @dataclass(frozen=True)
+class RolloutConfig:
+    """Drift-triggered retrain/shadow/canary rollout (serving/rollout.py).
+
+    The closed loop over the pieces the platform already has: a drift
+    recommendation (monitoring/profile.DriftMonitor) drains the
+    least-loaded fleet replica, retrains on its mesh
+    (workflows/retraining via parallel/dp.py), shadows the candidate
+    behind the live engine, and promotes through the hot-reload swap
+    only when every gate below passes -- fail-closed: any failure or
+    stage timeout rolls back to the old generation with the fleet
+    intact."""
+
+    # Master switch: a server/fleet only drives rollout cycles when this
+    # is on. The RDP_ROLLOUT env var overrides this value.
+    enabled: bool = False
+    # Registry alias the retraining pipeline parks the CANDIDATE under
+    # while it is gated (never "staging": the serving alias must not move
+    # until promotion).
+    candidate_alias: str = "shadow"
+    # Fraction of live frames the serving replicas mirror to the
+    # candidate during SHADOW (candidate results are never returned to
+    # callers; they are diffed against the serving generation's outputs).
+    shadow_fraction: float = 0.5
+    # Minimum mirrored frames the shadow diff must cover before the gate
+    # may pass; fewer by the stage timeout = fail (not "pass by default").
+    shadow_min_frames: int = 16
+    # Per-replica cap on queued-but-undiffed shadow frames (the mirror
+    # hook never blocks a serving handler thread; overflow is dropped
+    # and counted, not waited for).
+    shadow_queue: int = 64
+    # -- promotion gates (ALL must pass; each verdict is counted in
+    # rdp_rollout_gate_verdicts_total) ----------------------------------
+    # PR 8 parity fixtures: candidate vs the live generation over
+    # quant.golden_frames (deterministic synthetic scenes).
+    gate_fixture_frames: int = 4
+    gate_fixture_min_iou: float = 0.80
+    gate_fixture_max_curv_err: float = 1.0
+    # Live shadow diff: candidate vs serving outputs on the SAME mirrored
+    # frames.
+    gate_shadow_min_iou: float = 0.50
+    gate_shadow_max_curv_err: float = 1.0
+    # Candidate-vs-serving drift score: worst per-signal
+    # noise-floor-adjusted PSI between the candidate's and the live
+    # engine's signal distributions over the mirrored frames (same
+    # frames, so sampling noise is shared; a candidate behaving wildly
+    # differently from the model it replaces fails here even if its
+    # masks overlap). Note the Laplace smoothing caps PSI near ~1.6 at
+    # the default 16-frame window -- 1.0 sits well above same-model
+    # noise (measured ~0 in tests) and well below a distribution swap.
+    gate_shadow_max_psi: float = 1.0
+    # -- per-stage timeouts (a stage exceeding its budget rolls the cycle
+    # back; the fleet keeps serving the old generation) -----------------
+    drain_timeout_s: float = 30.0
+    retrain_timeout_s: float = 1800.0
+    shadow_timeout_s: float = 120.0
+    promote_timeout_s: float = 60.0
+
+
+@dataclass(frozen=True)
 class ClientConfig:
     """Reference: services/vision_analysis/client.py:43-45."""
 
@@ -498,6 +557,7 @@ class PlatformConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     geometry: GeometryConfig = field(default_factory=GeometryConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
+    rollout: RolloutConfig = field(default_factory=RolloutConfig)
     client: ClientConfig = field(default_factory=ClientConfig)
     drift: DriftConfig = field(default_factory=DriftConfig)
     calibration: CalibrationConfig = field(default_factory=CalibrationConfig)

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 
 from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
+from robotic_discovery_platform_tpu.observability import instruments as obs
 from robotic_discovery_platform_tpu.utils.config import (
     DriftConfig,
     ModelConfig,
@@ -113,8 +114,12 @@ def run_retraining_pipeline(
         )
         # ship the drift reference with the promotion: the serving side
         # scores live traffic against THIS version's eval-set signal
-        # distributions (failure is non-fatal -- the server self-baselines
-        # when a version has no profile)
+        # distributions. Failure is non-fatal (the server self-baselines
+        # when a version has no profile) but never silent: a fleet whose
+        # promoted versions keep shipping without references is anchoring
+        # drift detection to its own early traffic instead of the eval
+        # set, and rdp_drift_profile_failures_total is how that shows up
+        # on a dashboard.
         profile_path = None
         try:
             profile_path = capture_drift_profile(
@@ -123,9 +128,15 @@ def run_retraining_pipeline(
                 tracking_uri=cfg.tracking_uri,
                 img_size=cfg.img_size,
             )
-        except Exception:
-            log.exception("drift-profile capture failed; the server will "
-                          "self-baseline this version")
+        except Exception as exc:
+            obs.DRIFT_PROFILE_FAILURES.inc()
+            log.warning(
+                "drift-profile capture for %s v%s failed (%s: %s); every "
+                "server adopting this version will self-baseline "
+                "(counted in rdp_drift_profile_failures_total)",
+                cfg.registered_model_name, latest.version,
+                type(exc).__name__, exc, exc_info=True,
+            )
         msg = (
             f"version {latest.version} of {cfg.registered_model_name!r} "
             f"promoted to @{alias} (val_loss {result.best_val_loss:.4f})"
@@ -155,7 +166,15 @@ def run_if_drifted(
         log.info("no retraining: %s", report.reason)
         return None
     log.warning("drift detected (%s); launching retraining", report.reason)
-    return run_retraining_pipeline(train_cfg, model_cfg, arrays=arrays, mesh=mesh)
+    result = run_retraining_pipeline(train_cfg, model_cfg, arrays=arrays,
+                                     mesh=mesh)
+    if not result.succeeded:
+        # the pipeline logs-not-raises (reference behavior), but a
+        # drift-GATED run failing means the loop detected a problem and
+        # could not fix it -- that must surface louder than a log.info
+        log.error("drift-gated retraining FAILED: %s -- the drifted "
+                  "model keeps serving", result.message)
+    return result
 
 
 if __name__ == "__main__":

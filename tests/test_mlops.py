@@ -106,9 +106,55 @@ def test_drift_gated_retraining(train_setup, tmp_path):
     dcfg = DriftConfig(metrics_csv=str(csv), report_path=str(tmp_path / "r.png"))
     res = retraining.run_if_drifted(dcfg, cfg, model_cfg, arrays=arrays)
     assert res is not None and res.succeeded
+    assert res.version == 1 and res.promoted_alias == "staging"
     # no drift -> no retraining
     _write_metrics(csv, [50.0] * 60)
     assert retraining.run_if_drifted(dcfg, cfg, model_cfg, arrays=arrays) is None
+
+
+def test_drift_gated_retraining_failure_is_surfaced(train_setup, tmp_path,
+                                                    caplog):
+    """drifted + broken pipeline: run_if_drifted must return the FAILED
+    result (not None, not a raise the caller never sees) and log it at
+    error level -- the loop detected a problem it could not fix."""
+    import dataclasses as dc
+    import logging
+
+    cfg, model_cfg, _ = train_setup
+    csv = tmp_path / "m.csv"
+    _write_metrics(csv, [50.0] * 30 + [5.0] * 30)  # definitely drifted
+    dcfg = DriftConfig(metrics_csv=str(csv),
+                       report_path=str(tmp_path / "r.png"))
+    bad = dc.replace(cfg, dataset_dir="/nonexistent/rollout-path")
+    with caplog.at_level(logging.ERROR,
+                         logger="robotic_discovery_platform_tpu"):
+        res = retraining.run_if_drifted(dcfg, bad, model_cfg, arrays=None)
+    assert res is not None and not res.succeeded
+    assert res.version is None
+    assert "FileNotFoundError" in res.message or "dataset" in res.message
+    assert any("drift-gated retraining FAILED" in r.message
+               for r in caplog.records)
+
+
+def test_profile_capture_failure_is_counted(train_setup, monkeypatch):
+    """A failed drift-profile capture must not fail the pipeline -- but
+    it must be counted and warned, never swallowed silently (a fleet
+    whose versions ship without references self-baselines blind)."""
+    from robotic_discovery_platform_tpu.observability import (
+        instruments as obs,
+    )
+
+    cfg, model_cfg, arrays = train_setup
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("eval scenes unavailable")
+
+    monkeypatch.setattr(retraining, "capture_drift_profile", boom)
+    before = obs.DRIFT_PROFILE_FAILURES.value
+    res = retraining.run_retraining_pipeline(cfg, model_cfg, arrays=arrays)
+    assert res.succeeded  # capture failure stays non-fatal
+    assert res.drift_profile_path is None
+    assert obs.DRIFT_PROFILE_FAILURES.value == before + 1
 
 
 def test_collect_and_replay(tmp_path):

@@ -1,0 +1,881 @@
+"""Drift-triggered rollout tests (serving/rollout.py + the drain/promote
+wiring in serving/server.py and serving/fleet.py).
+
+Four layers, cheapest first:
+
+- state-machine units over fake targets with a fake clock: stage order,
+  least-loaded pick, shadow mirroring, gate matrix, rollback on failure /
+  timeout at every stage (no sleeps, no sockets, no models);
+- shadow-runner units: sampling fraction, queue-overflow drop accounting,
+  candidate-error evidence;
+- graceful-drain membership: a draining replica leaves NEW-stream
+  placement while staying healthy (no breaker, no failover) -- the
+  distinction from a health drop-out, asserted both on the router and on
+  a live relayed stream;
+- live chaos acceptance: a 2-replica in-process CPU fleet with frames
+  flowing through the front-end while a full cycle runs -- a deliberately
+  bad candidate (zeroed head) is rejected fail-closed with zero lost
+  frames and the replica rejoins; a good candidate promotes everywhere
+  and the drift reference re-stamps ATOMICALLY with the engine swap.
+"""
+
+import copy
+import queue
+import threading
+import time
+from typing import NamedTuple
+
+import grpc
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu import tracking
+from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.serving import (
+    client as client_lib,
+    fleet as fleet_lib,
+    frontend as frontend_lib,
+    health as health_lib,
+    rollout as rollout_lib,
+    server as server_lib,
+)
+from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+from robotic_discovery_platform_tpu.utils.config import (
+    ModelConfig,
+    RolloutConfig,
+    ServerConfig,
+)
+
+H, W = 120, 160
+
+
+# -- fakes -------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+class FakeProfile(NamedTuple):
+    valid: object
+    mean_curvature: object
+    max_curvature: object
+
+
+class FakeAnalysis(NamedTuple):
+    mask: object
+    mask_coverage: object
+    profile: FakeProfile
+    confidence_margin: object
+
+
+def _analysis(mask, mean_k=1.0, valid=True, margin=0.3):
+    cov = 100.0 * float(np.count_nonzero(mask)) / mask.size
+    return FakeAnalysis(
+        mask=mask, mask_coverage=np.float32(cov),
+        profile=FakeProfile(valid=np.bool_(valid),
+                            mean_curvature=np.float32(mean_k),
+                            max_curvature=np.float32(2 * mean_k)),
+        confidence_margin=np.float32(margin),
+    )
+
+
+def _sample(mask=None, mean_k=1.0, valid=True):
+    mask = mask if mask is not None else np.ones((8, 8), np.uint8)
+    depth = np.full((8, 8), 500, np.uint16)
+    return rollout_lib.ShadowSample(
+        rgb=np.zeros((8, 8, 3), np.uint8), depth=depth,
+        k=np.eye(3, dtype=np.float32), depth_scale=0.001, mask=mask,
+        coverage=100.0 * float(np.count_nonzero(mask)) / mask.size,
+        mean_curvature=mean_k, max_curvature=2 * mean_k, valid=valid,
+        confidence_margin=0.3, depth_valid_fraction=1.0,
+    )
+
+
+class FakeTarget:
+    """The six-member rollout target surface, no servicer behind it."""
+
+    def __init__(self, name, streams=0, version=1):
+        self.name = name
+        self.streams = streams
+        self.current_version = version
+        self.draining = False
+        self.shadow_hook = None
+        self.promote_calls = 0
+        self.promote_to = None  # version adopted on promote()
+        self.feed_on_shadow = 0  # samples pushed when the tap installs
+
+    @property
+    def active_streams(self):
+        return self.streams() if callable(self.streams) else self.streams
+
+    def set_draining(self, draining):
+        self.draining = bool(draining)
+
+    def set_shadow(self, hook):
+        self.shadow_hook = hook
+        if hook is not None:
+            for _ in range(self.feed_on_shadow):
+                hook(_sample())
+
+    def promote(self):
+        self.promote_calls += 1
+        if self.promote_to is not None:
+            self.current_version = self.promote_to
+        return True
+
+    def reference_analyzer(self):
+        return lambda rgb, depth, k, scale: _analysis(
+            np.ones((8, 8), np.uint8))
+
+
+class FakeResult(NamedTuple):
+    succeeded: bool
+    version: object
+    message: str = ""
+
+
+class StubManager(rollout_lib.RolloutManager):
+    """RolloutManager with the model-touching edges stubbed: candidate
+    loading and the fixture fixtures return test-injected values, the
+    promotion acts on targets only (no registry)."""
+
+    def __init__(self, *args, candidate_mask=None, fixture=None,
+                 promote_error=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._cand_mask = (candidate_mask if candidate_mask is not None
+                           else np.ones((8, 8), np.uint8))
+        self._fixture = fixture or {
+            "mask_iou_mean": 1.0, "curvature_err_max": 0.0}
+        self._promote_error = promote_error
+
+    def _load_candidate(self, version):
+        mask = self._cand_mask
+
+        def analyze(variables, rgb, depth, k, scale):
+            return _analysis(mask)
+
+        return analyze, {}
+
+    def _fixture_report(self, reference, cand_analyze, cand_variables):
+        return dict(self._fixture)
+
+    def _promote(self, cycle, version):
+        if self._promote_error is not None:
+            raise self._promote_error
+        for t in self.targets:
+            t.promote_to = int(version)
+            t.promote()
+
+
+def _stub(targets, clock=None, train_fn=None, **cfg_kw):
+    clock = clock or FakeClock()
+    defaults = dict(
+        shadow_fraction=1.0, shadow_min_frames=2, shadow_queue=16,
+        drain_timeout_s=2.0, retrain_timeout_s=2.0, shadow_timeout_s=2.0,
+        promote_timeout_s=2.0, gate_shadow_min_iou=0.5,
+        gate_shadow_max_psi=1.0,
+    )
+    defaults.update(cfg_kw)
+    stub_kw = {}
+    for k in ("candidate_mask", "fixture", "promote_error"):
+        if k in defaults:
+            stub_kw[k] = defaults.pop(k)
+    mgr = StubManager(
+        targets, RolloutConfig(**defaults), ServerConfig(),
+        train_fn=train_fn or (lambda target: FakeResult(True, 7)),
+        clock=clock, sleep=clock.sleep, **stub_kw,
+    )
+    return mgr, clock
+
+
+def _rec(reason="test excursion"):
+    class Rec:
+        signals = ["mask_coverage"]
+
+    Rec.reason = reason
+    return Rec()
+
+
+# -- state-machine units -----------------------------------------------------
+
+
+def test_env_resolve(monkeypatch):
+    monkeypatch.delenv("RDP_ROLLOUT", raising=False)
+    assert rollout_lib.resolve_rollout_enabled(False) is False
+    assert rollout_lib.resolve_rollout_enabled(True) is True
+    monkeypatch.setenv("RDP_ROLLOUT", "1")
+    assert rollout_lib.resolve_rollout_enabled(False) is True
+    monkeypatch.setenv("RDP_ROLLOUT", "off")
+    assert rollout_lib.resolve_rollout_enabled(True) is False
+
+
+def test_happy_path_promotes_and_rejoins():
+    a, b = FakeTarget("a", streams=2), FakeTarget("b", streams=0)
+    b.feed_on_shadow = 0
+    a.feed_on_shadow = 4  # the live replica mirrors frames into the tap
+    mgr, clock = _stub([a, b])
+    cycle = mgr.run_cycle(_rec())
+    assert cycle["outcome"] == "promoted"
+    assert cycle["replica"] == "b"  # least-loaded drained
+    assert cycle["candidate_version"] == 7
+    # stage order recorded
+    stages = [s["stage"] for s in cycle["stages"]]
+    assert stages == [
+        rollout_lib.DRAINING, rollout_lib.RETRAINING, rollout_lib.SHADOW,
+        rollout_lib.CANARY, rollout_lib.PROMOTING, rollout_lib.REJOINING,
+    ]
+    # drained replica rejoined, every target promoted, tap cleared
+    assert b.draining is False
+    assert a.current_version == b.current_version == 7
+    assert a.shadow_hook is None
+    assert mgr.state == rollout_lib.IDLE
+    assert cycle["gates"]["shadow_iou"]["pass"]
+    snap = mgr.snapshot()
+    assert snap["state"] == "idle"
+    assert snap["history"][-1]["outcome"] == "promoted"
+
+
+def test_gate_failure_rolls_back_fail_closed():
+    a, b = FakeTarget("a", streams=1), FakeTarget("b")
+    a.feed_on_shadow = 4
+    # zeroed-head candidate: empty masks vs the live all-ones masks
+    mgr, _ = _stub([a, b], candidate_mask=np.zeros((8, 8), np.uint8),
+                   fixture={"mask_iou_mean": 0.0, "curvature_err_max": 0.0})
+    before = obs.ROLLOUT_ROLLBACKS.labels(stage="canary").value
+    cycle = mgr.run_cycle(_rec())
+    assert cycle["outcome"] == "rolled_back"
+    assert cycle["rolled_back_at"] == rollout_lib.CANARY
+    failed = {g for g, v in cycle["gates"].items() if not v["pass"]}
+    assert {"fixture_iou", "shadow_iou"} <= failed
+    # fleet intact: nothing promoted, replica un-drained, state IDLE
+    assert a.current_version == b.current_version == 1
+    assert b.draining is False
+    assert mgr.state == rollout_lib.IDLE
+    assert obs.ROLLOUT_ROLLBACKS.labels(stage="canary").value == before + 1
+
+
+def test_retrain_failure_rolls_back():
+    a, b = FakeTarget("a", streams=1), FakeTarget("b")
+    mgr, _ = _stub([a, b], train_fn=lambda t: FakeResult(
+        False, None, "training exploded"))
+    cycle = mgr.run_cycle(_rec())
+    assert cycle["outcome"] == "rolled_back"
+    assert cycle["rolled_back_at"] == rollout_lib.RETRAINING
+    assert "training exploded" in cycle["error"]
+    assert b.draining is False and mgr.state == rollout_lib.IDLE
+
+
+def test_retrain_crash_is_surfaced_not_swallowed():
+    a, b = FakeTarget("a", streams=1), FakeTarget("b")
+
+    def boom(target):
+        raise RuntimeError("OOM mid-epoch")
+
+    mgr, _ = _stub([a, b], train_fn=boom)
+    cycle = mgr.run_cycle(_rec())
+    assert cycle["outcome"] == "rolled_back"
+    assert "OOM mid-epoch" in cycle["error"]
+    assert b.draining is False and mgr.state == rollout_lib.IDLE
+
+
+def test_drain_timeout_lands_back_in_idle():
+    a = FakeTarget("a", streams=1)
+    b = FakeTarget("b", streams=0)
+    b.streams = 1  # never drains
+    mgr, clock = _stub([a, b], drain_timeout_s=0.5)
+    cycle = mgr.run_cycle(_rec())
+    assert cycle["outcome"] == "rolled_back"
+    assert cycle["rolled_back_at"] == rollout_lib.DRAINING
+    assert b.draining is False, "rollback must un-drain the stuck replica"
+    assert mgr.state == rollout_lib.IDLE
+
+
+def test_retrain_timeout_discards_candidate():
+    a, b = FakeTarget("a", streams=1), FakeTarget("b")
+    release = threading.Event()
+
+    def hung_train(target):
+        release.wait(timeout=30)
+        return FakeResult(True, 9)
+
+    mgr, clock = _stub([a, b], train_fn=hung_train, retrain_timeout_s=0.5)
+    try:
+        cycle = mgr.run_cycle(_rec())
+    finally:
+        release.set()
+    assert cycle["outcome"] == "rolled_back"
+    assert cycle["rolled_back_at"] == rollout_lib.RETRAINING
+    assert "exceeded" in cycle["error"]
+    # nothing promoted even though the train thread eventually finishes
+    assert a.current_version == b.current_version == 1
+    assert b.draining is False and mgr.state == rollout_lib.IDLE
+
+
+def test_shadow_timeout_without_frames_fails_closed():
+    a, b = FakeTarget("a", streams=1), FakeTarget("b")
+    a.feed_on_shadow = 0  # no live traffic ever mirrored
+    mgr, _ = _stub([a, b], shadow_timeout_s=0.5, shadow_min_frames=4)
+    cycle = mgr.run_cycle(_rec())
+    # too few shadow frames = the shadow_frames gate fails (never a
+    # promote-by-default)
+    assert cycle["outcome"] == "rolled_back"
+    assert cycle["rolled_back_at"] == rollout_lib.CANARY
+    assert not cycle["gates"]["shadow_frames"]["pass"]
+    assert a.current_version == b.current_version == 1
+
+
+def test_promote_failure_rolls_back():
+    a, b = FakeTarget("a", streams=1), FakeTarget("b")
+    a.feed_on_shadow = 4
+    mgr, _ = _stub([a, b],
+                   promote_error=RuntimeError("registry unreachable"))
+    cycle = mgr.run_cycle(_rec())
+    assert cycle["outcome"] == "rolled_back"
+    assert cycle["rolled_back_at"] == rollout_lib.PROMOTING
+    assert b.draining is False and mgr.state == rollout_lib.IDLE
+
+
+def test_single_replica_is_never_drained():
+    only = FakeTarget("only")
+    mgr, _ = _stub([only])
+    before = obs.ROLLOUT_SKIPPED.labels(reason="no_spare_replica").value
+    cycle = mgr.run_cycle(_rec())
+    assert cycle["outcome"] == "skipped"
+    assert only.draining is False
+    assert obs.ROLLOUT_SKIPPED.labels(
+        reason="no_spare_replica").value == before + 1
+
+
+def test_recommendation_skipped_while_busy():
+    a, b = FakeTarget("a"), FakeTarget("b")
+    mgr, _ = _stub([a, b])
+    with mgr._lock:
+        mgr._state = rollout_lib.SHADOW  # simulate a running cycle
+    before = obs.ROLLOUT_SKIPPED.labels(reason="busy").value
+    assert mgr.on_recommendation(_rec()) is False
+    assert obs.ROLLOUT_SKIPPED.labels(reason="busy").value == before + 1
+    with mgr._lock:
+        mgr._state = rollout_lib.IDLE
+    assert mgr.on_recommendation(_rec()) is True
+
+
+def test_worker_thread_services_recommendations():
+    a, b = FakeTarget("a", streams=1), FakeTarget("b")
+    a.feed_on_shadow = 4
+    mgr, _ = _stub([a, b])
+    mgr.start()
+    try:
+        assert mgr.on_recommendation(_rec()) is True
+        deadline = time.monotonic() + 10
+        while not mgr.history and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mgr.history and mgr.history[-1]["outcome"] == "promoted"
+    finally:
+        mgr.stop()
+
+
+# -- gate matrix -------------------------------------------------------------
+
+
+def _reports(**overrides):
+    fixture = {"mask_iou_mean": 1.0, "curvature_err_max": 0.0}
+    shadow = {"frames": 32, "mask_iou_mean": 1.0, "curvature_err_max": 0.0,
+              "psi_max": 0.0}
+    for k, v in overrides.items():
+        (fixture if k.startswith("f_") else shadow)[k[2:]] = v
+    return fixture, shadow
+
+
+@pytest.mark.parametrize("overrides,failed_gate", [
+    ({}, None),
+    ({"f_mask_iou_mean": 0.5}, "fixture_iou"),
+    ({"f_curvature_err_max": 5.0}, "fixture_curv"),
+    ({"s_frames": 1}, "shadow_frames"),
+    ({"s_mask_iou_mean": 0.1}, "shadow_iou"),
+    ({"s_curvature_err_max": 5.0}, "shadow_curv"),
+    ({"s_psi_max": 10.0}, "shadow_psi"),
+])
+def test_gate_matrix(overrides, failed_gate):
+    cfg = RolloutConfig(shadow_min_frames=16)
+    fixture, shadow = _reports(**overrides)
+    passed, verdicts = rollout_lib.evaluate_gates(cfg, fixture, shadow)
+    if failed_gate is None:
+        assert passed
+    else:
+        assert not passed
+        assert not verdicts[failed_gate]["pass"]
+        others = {g for g, v in verdicts.items() if not v["pass"]}
+        assert others == {failed_gate}
+
+
+# -- shadow runner units -----------------------------------------------------
+
+
+def _runner(mask=None, fraction=1.0, max_queue=8):
+    mask = mask if mask is not None else np.ones((8, 8), np.uint8)
+
+    def analyze(variables, rgb, depth, k, scale):
+        return _analysis(mask)
+
+    return rollout_lib.ShadowRunner(analyze, {}, fraction=fraction,
+                                    max_queue=max_queue)
+
+
+def test_shadow_runner_identical_candidate_scores_clean():
+    r = _runner()
+    for _ in range(8):
+        r.hook(_sample())
+    while r.process_one(timeout_s=0.0):
+        pass
+    rep = r.report()
+    assert rep["frames"] == 8 and rep["errors"] == 0
+    assert rep["mask_iou_mean"] == 1.0
+    assert rep["curvature_err_max"] == 0.0
+    assert rep["psi_max"] < 0.5  # same distribution, under any real gate
+
+
+def test_shadow_runner_divergent_candidate_is_visible():
+    r = _runner(mask=np.zeros((8, 8), np.uint8))
+    for _ in range(16):
+        r.hook(_sample())
+    while r.process_one(timeout_s=0.0):
+        pass
+    rep = r.report()
+    assert rep["mask_iou_mean"] == 0.0
+    # coverage 100 vs 0: over the default gate (Laplace smoothing caps
+    # PSI near ~1.6 at these window sizes, hence the 1.0 default)
+    assert rep["psi_max"] > RolloutConfig().gate_shadow_max_psi
+
+
+def test_shadow_runner_sampling_fraction():
+    r = _runner(fraction=0.25, max_queue=64)
+    for _ in range(64):
+        r.hook(_sample())
+    assert r.mirrored == 16
+    assert r.dropped == 0
+
+
+def test_shadow_runner_overflow_drops_not_blocks():
+    r = _runner(max_queue=4)
+    t0 = time.monotonic()
+    for _ in range(20):
+        r.hook(_sample())
+    assert time.monotonic() - t0 < 1.0  # never blocked a handler
+    assert r.mirrored == 4
+    assert r.dropped == 16
+    while r.process_one(timeout_s=0.0):
+        pass
+    assert r.report()["frames"] == 4
+
+
+def test_shadow_runner_candidate_error_counts_against_gate():
+    def broken(variables, rgb, depth, k, scale):
+        raise ValueError("candidate NaN")
+
+    r = rollout_lib.ShadowRunner(broken, {}, fraction=1.0, max_queue=8)
+    for _ in range(4):
+        r.hook(_sample())
+    while r.process_one(timeout_s=0.0):
+        pass
+    rep = r.report()
+    assert rep["errors"] == 4
+    assert rep["frames"] == 0  # errored frames never count as evidence
+
+
+# -- live fleet: graceful drain + full cycles --------------------------------
+
+
+@pytest.fixture(scope="module")
+def sensitive_model(tmp_path_factory):
+    """A registered model whose head is brightness-sensitive (the
+    tools/drift_smoke.py recipe): live masks are non-empty, so a
+    zeroed-head candidate genuinely diverges instead of matching
+    empty-vs-empty."""
+    import jax
+    from flax.core import unfreeze
+
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+
+    root = tmp_path_factory.mktemp("mlruns-rollout")
+    uri = f"file:{root}"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = unfreeze(
+        jax.device_get(init_unet(model, jax.random.key(0), img_size=64))
+    )
+    v = copy.deepcopy(variables)
+    v["params"]["Conv_0"]["kernel"] = (
+        np.asarray(v["params"]["Conv_0"]["kernel"]) * 40.0
+    )
+    v["params"]["Conv_0"]["bias"] = np.full((1,), 0.5, np.float32)
+    with tracking.start_run():
+        version = tracking.log_model(
+            v, mcfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", version
+    )
+    return uri, mcfg, v
+
+
+def _register_candidate(uri, mcfg, variables, *, zero_head=False,
+                        alias="shadow"):
+    """What a rollout train_fn does minus the gradient descent: register
+    a candidate version under the (non-staging) candidate alias."""
+    v = copy.deepcopy(variables)
+    if zero_head:
+        import jax
+
+        # zeroed weights end to end: logits 0 -> sigmoid 0.5 -> empty
+        # masks, the deliberately bad candidate the gates must reject
+        v = jax.tree_util.tree_map(
+            lambda a: np.zeros_like(np.asarray(a)), v)
+    tracking.set_tracking_uri(uri)
+    with tracking.start_run():
+        version = tracking.log_model(
+            v, mcfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", alias, version
+    )
+    return int(version)
+
+
+def _server_cfg(uri, tmp_path, name, port=0):
+    return ServerConfig(
+        address=f"localhost:{port}",
+        tracking_uri=uri,
+        model_img_size=64,
+        metrics_csv=str(tmp_path / f"{name}.csv"),
+        metrics_flush_every=1000,
+        calibration_path=str(tmp_path / "missing.npz"),
+        reload_poll_s=0.0,
+    )
+
+
+def _boot_replica(uri, tmp_path, name):
+    cfg = _server_cfg(uri, tmp_path, name)
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    return server, servicer, f"localhost:{port}", cfg
+
+
+class _LiveStream:
+    """A client stream through the front-end that keeps frames flowing
+    until stopped, counting sent vs received (zero-lost evidence)."""
+
+    def __init__(self, endpoint):
+        from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+
+        self._stop = threading.Event()
+        self._outbox: queue.Queue = queue.Queue(maxsize=4)
+        self.sent = 0
+        self.received = 0
+        self.errors = 0
+        self._channel = grpc.insecure_channel(endpoint)
+        stub = vision_grpc.VisionAnalysisServiceStub(self._channel)
+        src = SyntheticSource(width=W, height=H, seed=3, n_frames=10_000)
+        src.start()
+
+        def feeder():
+            while not self._stop.is_set():
+                color, depth = src.get_frames()
+                if color is None:
+                    break
+                req = client_lib.encode_request(color, depth)
+                while not self._stop.is_set():
+                    try:
+                        self._outbox.put(req, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+            self._outbox.put(None)
+
+        def gen():
+            while True:
+                item = self._outbox.get()
+                if item is None:
+                    return
+                self.sent += 1
+                yield item
+                time.sleep(0.02)
+
+        self._feeder = threading.Thread(target=feeder, daemon=True,
+                                        name="rollout-test-feeder")
+        self._feeder.start()
+        self._call = stub.AnalyzeActuatorPerformance(gen())
+
+        def drain():
+            try:
+                for resp in self._call:
+                    self.received += 1
+                    if resp.status.startswith("ERROR"):
+                        self.errors += 1
+            except grpc.RpcError:
+                pass
+
+        self._drainer = threading.Thread(target=drain, daemon=True,
+                                         name="rollout-test-drainer")
+        self._drainer.start()
+
+    def stop(self):
+        self._stop.set()
+        self._feeder.join(timeout=10)
+        self._drainer.join(timeout=30)
+        self._channel.close()
+
+
+def test_graceful_drain_vs_health_dropout(sensitive_model, tmp_path):
+    """Satellite: draining=true leaves NEW-stream placement before health
+    ever flips (no breaker, no failover, in-flight stream completes);
+    NOT_SERVING is the failover path (breaker counts it)."""
+    uri, _, _ = sensitive_model
+    server, servicer, endpoint, _ = _boot_replica(uri, tmp_path, "drain")
+    router = fleet_lib.FleetRouter([endpoint], poll_s=60.0)
+    r = router.replicas[0]
+    try:
+        assert router.poll_once() == 1 and r.placeable
+
+        # graceful drain: healthy but unplaceable, and NOT quarantined
+        servicer.set_draining(True)
+        assert router.poll_once() == 0
+        assert r.serving and r.draining and not r.placeable
+        assert r.breaker.state == "closed"
+        assert router.quarantined_count == 0
+        assert router.draining_count == 1
+        assert router.pick() is None
+
+        # un-drain: placeable again without any half-open probe ceremony
+        servicer.set_draining(False)
+        assert router.poll_once() == 1
+        assert r.placeable and router.draining_count == 0
+
+        # the health drop-out path, for contrast: breaker counts failures
+        servicer.health.set_all(health_lib.NOT_SERVING)
+        assert router.poll_once() == 0
+        assert not r.serving and not r.placeable
+        assert r.breaker.failure_count >= 1
+    finally:
+        router.stop()
+        server.stop(grace=None)
+        servicer.close()
+
+
+def test_drained_replica_keeps_serving_inflight_stream(
+        sensitive_model, tmp_path):
+    """A stream already placed on a draining replica finishes there --
+    graceful drain must not fail it over."""
+    uri, _, _ = sensitive_model
+    s1, sv1, ep1, _ = _boot_replica(uri, tmp_path, "g1")
+    s2, sv2, ep2, _ = _boot_replica(uri, tmp_path, "g2")
+    f_server = fe = None
+    try:
+        cfg = ServerConfig(
+            address="localhost:0", fleet_replicas=f"{ep1},{ep2}",
+            fleet_poll_s=0.1,
+        )
+        f_server, fe = frontend_lib.build_frontend(cfg)
+        f_port = f_server.add_insecure_port("localhost:0")
+        f_server.start()
+        assert fe.router.wait_live(2, timeout_s=10)
+
+        stream = _LiveStream(f"localhost:{f_port}")
+        try:
+            deadline = time.monotonic() + 15
+            while stream.received < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert stream.received >= 2
+            placed = [r for r in fe.router.replicas if r.inflight > 0]
+            assert len(placed) == 1
+            victim_sv = sv1 if placed[0].endpoint == ep1 else sv2
+
+            # drain the replica the stream lives on
+            victim_sv.set_draining(True)
+            deadline = time.monotonic() + 10
+            while placed[0].placeable and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert not placed[0].placeable and placed[0].draining
+
+            # frames keep flowing on the SAME replica: no failover
+            base = stream.received
+            deadline = time.monotonic() + 15
+            while (stream.received < base + 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert stream.received >= base + 3
+            assert fe.router.failovers_total == 0
+            victim_sv.set_draining(False)
+        finally:
+            stream.stop()
+        assert stream.errors == 0
+        assert stream.received == stream.sent, "graceful drain lost frames"
+    finally:
+        if f_server is not None:
+            f_server.stop(grace=None)
+            fe.close()
+        for s, sv in ((s1, sv1), (s2, sv2)):
+            s.stop(grace=None)
+            sv.close()
+
+
+@pytest.mark.slow
+def test_live_cycle_bad_then_good_candidate(sensitive_model, tmp_path):
+    """Acceptance chaos: frames flow through the front-end for the WHOLE
+    test. Cycle 1 retrains into a zeroed-head candidate -- the shadow
+    gate rejects it, nothing promotes, zero frames lost, the drained
+    replica rejoins. Cycle 2 registers a faithful candidate -- it
+    promotes everywhere and the drift reference re-stamps with the
+    engine generation."""
+    uri, mcfg, good_vars = sensitive_model
+    s1, sv1, ep1, cfg1 = _boot_replica(uri, tmp_path, "c1")
+    s2, sv2, ep2, _ = _boot_replica(uri, tmp_path, "c2")
+    f_server = fe = None
+    phase = {"zero_head": True}
+
+    def train_fn(target):
+        version = _register_candidate(uri, mcfg, good_vars,
+                                      zero_head=phase["zero_head"])
+        return FakeResult(True, version)
+
+    try:
+        fcfg = ServerConfig(
+            address="localhost:0", fleet_replicas=f"{ep1},{ep2}",
+            fleet_poll_s=0.1,
+        )
+        f_server, fe = frontend_lib.build_frontend(fcfg)
+        f_port = f_server.add_insecure_port("localhost:0")
+        f_server.start()
+        assert fe.router.wait_live(2, timeout_s=10)
+
+        mgr = rollout_lib.RolloutManager(
+            [], RolloutConfig(
+                shadow_fraction=1.0, shadow_min_frames=3,
+                gate_shadow_min_iou=0.5, gate_shadow_max_psi=1.0,
+                gate_fixture_min_iou=0.8, gate_fixture_frames=2,
+                drain_timeout_s=30.0, retrain_timeout_s=120.0,
+                shadow_timeout_s=60.0, promote_timeout_s=60.0,
+            ),
+            cfg1, train_fn=train_fn,
+        )
+        rollout_lib.attach_rollout(mgr, [sv1, sv2], names=[ep1, ep2])
+        v0 = sv1.current_version
+
+        stream = _LiveStream(f"localhost:{f_port}")
+        try:
+            deadline = time.monotonic() + 20
+            while stream.received < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert stream.received >= 2
+
+            # -- cycle 1: bad candidate must be rejected fail-closed ---
+            cycle = mgr.run_cycle(_rec("injected for test"))
+            assert cycle["outcome"] == "rolled_back"
+            assert cycle["rolled_back_at"] == rollout_lib.CANARY
+            assert not cycle["gates"]["shadow_iou"]["pass"]
+            assert sv1.current_version == v0
+            assert sv2.current_version == v0
+            assert not sv1.is_draining and not sv2.is_draining
+            store = tracking.store_for(uri)
+            assert store.get_alias("Actuator-Segmenter", "staging") == v0
+
+            # the drained replica rejoins the placement ring
+            deadline = time.monotonic() + 10
+            while (fe.router.live_count < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert fe.router.live_count == 2
+
+            # -- cycle 2: a faithful candidate promotes ----------------
+            phase["zero_head"] = False
+            cycle2 = mgr.run_cycle(_rec("second excursion"))
+            assert cycle2["outcome"] == "promoted", cycle2.get("error")
+            v_new = cycle2["candidate_version"]
+            assert v_new != v0
+            assert sv1.current_version == v_new
+            assert sv2.current_version == v_new
+            # atomic re-stamp: engine generation and drift reference
+            # generation pair up on both replicas
+            for sv in (sv1, sv2):
+                version, gen = sv.version_and_reference()
+                assert version == v_new
+                assert gen == v_new
+            assert store.get_alias("Actuator-Segmenter",
+                                   "staging") == v_new
+            deadline = time.monotonic() + 10
+            while (fe.router.live_count < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert fe.router.live_count == 2
+        finally:
+            stream.stop()
+
+        # zero lost frames across drain + shadow + rollback + promote
+        assert stream.received == stream.sent
+        assert stream.errors == 0
+        snap = mgr.snapshot()
+        assert snap["cycles_total"] == 2
+        outcomes = [c["outcome"] for c in snap["history"]]
+        assert outcomes == ["rolled_back", "promoted"]
+    finally:
+        if f_server is not None:
+            f_server.stop(grace=None)
+            fe.close()
+        for s, sv in ((s1, sv1), (s2, sv2)):
+            s.stop(grace=None)
+            sv.close()
+
+
+def test_promotion_swaps_engine_and_reference_atomically(
+        sensitive_model, tmp_path):
+    """Satellite: a scrape racing the hot-reload swap must never observe
+    new weights paired with the old drift reference (or vice versa)."""
+    uri, mcfg, good_vars = sensitive_model
+    server, servicer, _, _ = _boot_replica(uri, tmp_path, "atomic")
+    try:
+        v0 = servicer.current_version
+        observed: list[tuple] = []
+        stop = threading.Event()
+
+        def scraper():
+            while not stop.is_set():
+                observed.append(servicer.version_and_reference())
+
+        t = threading.Thread(target=scraper, daemon=True,
+                             name="rollout-test-scraper")
+        t.start()
+        try:
+            v1 = _register_candidate(uri, mcfg, good_vars, alias="staging")
+            assert servicer.maybe_reload() is True
+            time.sleep(0.05)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert servicer.current_version == v1
+        versions_seen = {v for v, _ in observed}
+        assert versions_seen == {v0, v1}
+        for version, gen in observed:
+            assert gen == version, (
+                f"mid-promotion scrape paired engine v{version} with "
+                f"drift reference generation {gen}"
+            )
+        # the stats RPC payload carries the same consistent pair
+        stats = servicer.replica_stats()
+        assert stats["version"] == v1
+        assert stats["drift_generation"] == v1
+    finally:
+        server.stop(grace=None)
+        servicer.close()

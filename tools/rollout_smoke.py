@@ -1,0 +1,448 @@
+#!/usr/bin/env python
+"""CI rollout smoke: the full drift -> retrain -> shadow -> gate ->
+promote loop, end to end on a live 2-replica fleet.
+
+Boots two in-process replica servers behind the real fleet front-end and
+keeps ONE client stream of frames flowing for the whole run, then:
+
+1. streams **nominal** synthetic frames (the drift monitor
+   self-baselines), shifts to **darkened** frames (the
+   tools/drift_smoke.py recipe): exactly ONE retrain recommendation
+   fires, and the attached RolloutManager drains the idle fleet member;
+2. the injected "retraining" registers a **deliberately bad candidate**
+   (zeroed weights -> empty masks): the shadow gate rejects it
+   fail-closed -- the staging alias never moves, both replicas keep the
+   old generation, ZERO frames are lost across drain/shadow/rollback,
+   and the drained replica rejoins the placement ring;
+3. traffic returns to nominal, the PR 9 hysteresis re-arms, a second
+   excursion fires a second recommendation, and a **good candidate**
+   (faithful weights) passes every gate and promotes: both replicas hot
+   -reload to the new generation with the drift reference re-stamped
+   ATOMICALLY (version/drift_generation pair over the stats RPC), and
+   ``GET /debug/rollout`` shows the completed cycle history.
+
+Run under the strict sanitizers in CI::
+
+    env JAX_PLATFORMS=cpu RDP_LOCKCHECK=strict RDP_TRANSFER_GUARD=strict \
+        python tools/rollout_smoke.py
+
+Exit 0 on success, 1 with a diagnostic.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import queue
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+# runnable straight from a checkout, with or without `pip install -e .`
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+H, W = 120, 160
+BASELINE_FRAMES = 20
+# one rollout cycle pays fresh XLA compiles (candidate warm-up + fixture
+# reference analyzers) on top of the live traffic sharing the CPU --
+# generous on purpose, the assertions are about ORDER not speed
+WAIT_S = 600.0
+
+
+def _fail(msg: str, payload=None) -> int:
+    print(f"FAIL: {msg}")
+    if payload is not None:
+        print(json.dumps(payload, indent=1, default=str)[:4000])
+    return 1
+
+
+def _get_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30
+    ) as resp:
+        return resp.read().decode()
+
+
+class DriftingStream:
+    """ONE long-lived client stream through the front-end whose synthetic
+    camera can be shifted mid-stream (darkened images + degraded depth,
+    the drift_smoke recipe). Counts sent vs received: the zero-lost
+    ledger for the whole smoke."""
+
+    def __init__(self, endpoint: str):
+        import grpc
+
+        from robotic_discovery_platform_tpu.serving import (
+            client as client_lib,
+        )
+        from robotic_discovery_platform_tpu.serving.proto import (
+            vision_grpc,
+        )
+
+        self.shifted = False
+        self.sent = 0
+        self.received = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._outbox: queue.Queue = queue.Queue(maxsize=4)
+        self._rng = np.random.default_rng(7)
+        self._channel = grpc.insecure_channel(endpoint)
+        stub = vision_grpc.VisionAnalysisServiceStub(self._channel)
+
+        def render():
+            from robotic_discovery_platform_tpu.training.synthetic import (
+                render_scene,
+            )
+
+            img_rgb, _, depth = render_scene(self._rng, H, W)
+            if self.shifted:
+                img_rgb = (img_rgb.astype(np.float32) * 0.25
+                           ).astype(np.uint8)
+                depth = depth.copy()
+                depth[::2] = 0
+            return img_rgb[..., ::-1].copy(), depth  # BGR like a camera
+
+        def feeder():
+            while not self._stop.is_set():
+                color, depth = render()
+                req = client_lib.encode_request(color, depth)
+                while not self._stop.is_set():
+                    try:
+                        self._outbox.put(req, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+            self._outbox.put(None)
+
+        def gen():
+            while True:
+                item = self._outbox.get()
+                if item is None:
+                    return
+                self.sent += 1
+                yield item
+                # paced: the stream must keep flowing, not saturate the
+                # CPU the rollout's compiles are sharing
+                time.sleep(0.04)
+
+        self._feeder = threading.Thread(target=feeder, daemon=True,
+                                        name="smoke-feeder")
+        self._feeder.start()
+        call = stub.AnalyzeActuatorPerformance(gen())
+
+        def drain():
+            import grpc as _grpc
+
+            try:
+                for resp in call:
+                    self.received += 1
+                    if resp.status.startswith("ERROR"):
+                        self.errors += 1
+            except _grpc.RpcError:
+                pass
+
+        self._drainer = threading.Thread(target=drain, daemon=True,
+                                         name="smoke-drainer")
+        self._drainer.start()
+
+    def wait_received(self, n: int, timeout_s: float = WAIT_S) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while self.received < n and time.monotonic() < deadline:
+            time.sleep(0.05)
+        return self.received >= n
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._feeder.join(timeout=10)
+        self._drainer.join(timeout=60)
+        self._channel.close()
+
+
+def main() -> int:
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        force_cpu_platform,
+    )
+
+    force_cpu_platform(min_devices=1)
+
+    import jax
+    from flax.core import unfreeze
+
+    from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.serving import (
+        frontend as frontend_lib,
+        rollout as rollout_lib,
+        server as server_lib,
+    )
+    from robotic_discovery_platform_tpu.utils.config import (
+        ModelConfig,
+        RolloutConfig,
+        ServerConfig,
+    )
+
+    tmp = Path(tempfile.mkdtemp(prefix="rdp-rollout-smoke-"))
+    uri = f"file:{tmp}/mlruns"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    mcfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(mcfg)
+    variables = unfreeze(
+        jax.device_get(init_unet(model, jax.random.key(0), img_size=64))
+    )
+    # brightness-sensitive head (drift_smoke recipe): darkening genuinely
+    # moves coverage AND margin, and live masks are non-empty so a
+    # zeroed candidate genuinely diverges
+    good = copy.deepcopy(variables)
+    good["params"]["Conv_0"]["kernel"] = (
+        np.asarray(good["params"]["Conv_0"]["kernel"]) * 40.0
+    )
+    good["params"]["Conv_0"]["bias"] = np.full((1,), 0.5, np.float32)
+    with tracking.start_run():
+        v0 = int(tracking.log_model(
+            good, mcfg, registered_model_name="Actuator-Segmenter"))
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", v0)
+
+    def replica_cfg(name: str, metrics_port: int = 0) -> ServerConfig:
+        return ServerConfig(
+            address="localhost:0",
+            tracking_uri=uri,
+            model_img_size=64,
+            metrics_csv=str(tmp / f"{name}.csv"),
+            metrics_flush_every=1000,
+            calibration_path=str(tmp / "missing.npz"),
+            metrics_port=metrics_port,
+            reload_poll_s=0.0,
+            # fast drift knobs: small self-baseline, tight scoring
+            # stride, sub-second sustain, SHORT cooldown so the re-armed
+            # second excursion fits in a smoke run
+            drift_baseline_frames=BASELINE_FRAMES,
+            drift_window=64,
+            drift_score_every=8,
+            drift_psi_threshold=0.25,
+            drift_sustain_s=0.2,
+            drift_cooldown_s=2.0,
+        )
+
+    # the injected "retraining pipeline": registers a crafted candidate
+    # under the shadow alias -- zeroed weights first (must be rejected),
+    # faithful weights second (must promote)
+    phase = {"zero": True}
+
+    def train_fn(target):
+        v = (jax.tree_util.tree_map(
+            lambda a: np.zeros_like(np.asarray(a)), good)
+            if phase["zero"] else copy.deepcopy(good))
+        with tracking.start_run():
+            version = int(tracking.log_model(
+                v, mcfg, registered_model_name="Actuator-Segmenter"))
+        tracking.Client().set_registered_model_alias(
+            "Actuator-Segmenter", "shadow", version)
+        kind = "zeroed-head (bad)" if phase["zero"] else "faithful (good)"
+        print(f"train_fn: registered {kind} candidate v{version} on "
+              f"drained replica {target.name}")
+
+        class Result:
+            succeeded = True
+
+        Result.version = version
+        return Result()
+
+    servers, servicers = [], []
+    f_server = fe = stream = None
+    mgr = None
+    try:
+        endpoints = []
+        for i in range(2):
+            cfg = replica_cfg(f"r{i}", metrics_port=-1 if i == 0 else 0)
+            server, servicer = server_lib.build_server(cfg)
+            port = server.add_insecure_port("localhost:0")
+            server.start()
+            servers.append(server)
+            servicers.append(servicer)
+            endpoints.append(f"localhost:{port}")
+        debug_port = servicers[0].metrics_server.port
+
+        fcfg = ServerConfig(
+            address="localhost:0",
+            fleet_replicas=",".join(endpoints),
+            fleet_poll_s=0.1,
+        )
+        f_server, fe = frontend_lib.build_frontend(fcfg)
+        f_port = f_server.add_insecure_port("localhost:0")
+        f_server.start()
+        if not fe.router.wait_live(2, timeout_s=30):
+            return _fail("fleet never reached 2 live replicas")
+
+        mgr = rollout_lib.RolloutManager(
+            [], RolloutConfig(
+                shadow_fraction=1.0, shadow_min_frames=4,
+                gate_fixture_frames=2, gate_fixture_min_iou=0.8,
+                gate_shadow_min_iou=0.5, gate_shadow_max_psi=1.0,
+                drain_timeout_s=60.0, retrain_timeout_s=300.0,
+                shadow_timeout_s=180.0, promote_timeout_s=180.0,
+            ),
+            replica_cfg("mgr"), train_fn=train_fn,
+        )
+        rollout_lib.attach_rollout(mgr, servicers, names=endpoints)
+        mgr.start()
+
+        stream = DriftingStream(f"localhost:{f_port}")
+
+        # -- phase 1: nominal traffic baselines + scores clean ----------
+        if not stream.wait_received(BASELINE_FRAMES + 40):
+            return _fail("nominal phase stalled "
+                         f"(received {stream.received})")
+        if mgr.snapshot()["cycles_total"] != 0:
+            return _fail("a rollout cycle ran on NOMINAL traffic",
+                         mgr.snapshot())
+        print(f"nominal ok: {stream.received} frames served, no "
+              "recommendation, rollout idle")
+
+        # -- phase 2: drift fires ONE rec; bad candidate is rejected ----
+        stream.shifted = True
+        deadline = time.monotonic() + WAIT_S
+        while (mgr.snapshot()["cycles_total"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        snap = mgr.snapshot()
+        if snap["cycles_total"] < 1:
+            return _fail("drift never drove a rollout cycle", snap)
+        cycle1 = snap["history"][0]
+        if cycle1["outcome"] != "rolled_back":
+            return _fail("bad candidate was NOT rejected", cycle1)
+        if cycle1["rolled_back_at"] != "canary":
+            return _fail(
+                f"expected rejection at the canary gate, got "
+                f"{cycle1['rolled_back_at']}", cycle1)
+        gates = cycle1["gates"] or {}
+        if gates.get("shadow_iou", {}).get("pass", True):
+            return _fail("shadow IoU gate passed a zeroed candidate",
+                         gates)
+        text = _scrape(debug_port)
+        recs = [ln for ln in text.splitlines()
+                if ln.startswith("rdp_drift_recommendations_total")]
+        if not recs or not recs[0].endswith(" 1"):
+            return _fail("expected exactly 1 drift recommendation", recs)
+        store = tracking.store_for(uri)
+        if store.get_alias("Actuator-Segmenter", "staging") != v0:
+            return _fail("staging alias moved despite gate rejection")
+        for i, sv in enumerate(servicers):
+            if sv.current_version != v0:
+                return _fail(f"replica {i} left the old generation "
+                             "after a rejected candidate")
+            if sv.is_draining:
+                return _fail(f"replica {i} stuck DRAINING after rollback")
+        deadline = time.monotonic() + 30
+        while fe.router.live_count < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if fe.router.live_count != 2:
+            return _fail("drained replica never rejoined the ring")
+        print(f"rejection ok: bad candidate v{cycle1['candidate_version']}"
+              " rolled back at the canary gate, alias unchanged, replica "
+              "rejoined")
+
+        # -- phase 3: recover, re-arm, good candidate promotes ----------
+        phase["zero"] = False
+        stream.shifted = False
+        base = stream.received
+        if not stream.wait_received(base + 80):
+            return _fail("recovery phase stalled")
+        stream.shifted = True
+        deadline = time.monotonic() + WAIT_S
+        while (mgr.snapshot()["cycles_total"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        snap = mgr.snapshot()
+        if snap["cycles_total"] < 2:
+            return _fail("hysteresis never re-armed a second cycle "
+                         "(PR 9 recovery + cooldown)", snap)
+        cycle2 = snap["history"][1]
+        if cycle2["outcome"] != "promoted":
+            return _fail("good candidate did not promote", cycle2)
+        v_new = cycle2["candidate_version"]
+        for i, sv in enumerate(servicers):
+            version, gen = sv.version_and_reference()
+            if version != v_new:
+                return _fail(f"replica {i} serves v{version}, expected "
+                             f"promoted v{v_new}")
+            if gen != v_new:
+                return _fail(
+                    f"replica {i} pairs engine v{version} with drift "
+                    f"reference generation {gen} -- the atomic re-stamp "
+                    "broke")
+        if store.get_alias("Actuator-Segmenter", "staging") != v_new:
+            return _fail("staging alias does not point at the promoted "
+                         "version")
+        print(f"promotion ok: good candidate v{v_new} serving on both "
+              "replicas, drift reference re-stamped")
+
+        # -- /debug/rollout + metric families ---------------------------
+        debug = _get_json(debug_port, "/debug/rollout")
+        if not debug.get("enabled") or debug.get("state") != "idle":
+            return _fail("/debug/rollout not idle after the run", debug)
+        outcomes = [c["outcome"] for c in debug.get("history", [])]
+        if outcomes != ["rolled_back", "promoted"]:
+            return _fail(f"/debug/rollout history {outcomes}", debug)
+        text = _scrape(debug_port)
+        for family in ("rdp_rollout_state", "rdp_rollout_transitions_total",
+                       "rdp_rollout_shadow_frames_total",
+                       "rdp_rollout_gate_verdicts_total",
+                       "rdp_rollout_rollbacks_total",
+                       "rdp_fleet_replicas_draining"):
+            if f"# TYPE {family} " not in text:
+                return _fail(f"/metrics is missing {family}")
+        if 'rdp_rollout_state{state="idle"} 1' not in text:
+            return _fail("rdp_rollout_state gauge not back at idle")
+        print("observability ok: /debug/rollout shows both cycles, "
+              "rdp_rollout_* families exported")
+
+        # -- zero lost frames across the WHOLE run ----------------------
+        stream.stop()
+        stopped = stream
+        stream = None
+        if stopped.received != stopped.sent:
+            return _fail(
+                f"LOST FRAMES: sent {stopped.sent}, answered "
+                f"{stopped.received} across drain/shadow/rollback/promote")
+        if stopped.errors:
+            return _fail(f"{stopped.errors} frames error-completed; "
+                         "expected zero across the rollout")
+        print(f"zero-lost ok: {stopped.sent} frames sent, "
+              f"{stopped.received} answered, 0 errors")
+    finally:
+        if stream is not None:
+            stream.stop()
+        if mgr is not None:
+            mgr.stop()
+        if f_server is not None:
+            f_server.stop(grace=None)
+        if fe is not None:
+            fe.close()
+        for server in servers:
+            server.stop(grace=None)
+        for servicer in servicers:
+            servicer.close()
+
+    print("ROLLOUT SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
