@@ -1,0 +1,145 @@
+"""The model-zoo variant catalog: the named engine generations a serving
+process can hold side by side (serving/zoo.py).
+
+The platform's seed workload is ONE binary actuator segmenter per fleet.
+The zoo breaks that pairing: a server advertises M named variants, each
+with its own registry entry, precision tier, golden-frame parity gate,
+and drift reference, statistically multiplexed over the shared chip mesh
+(AlpaServe, PAPERS.md). This module is the catalog half -- pure
+declarations plus builders, importable without jax so config resolution
+and the bench can reason about variants before any device exists.
+
+Variants shipped:
+
+- ``seg``   -- the seed binary segmenter (the default model; empty
+  ``AnalysisRequest.model`` on the wire resolves here, so pre-zoo
+  clients interoperate unchanged). Registry entry: the server's
+  configured ``model_name`` ("Actuator-Segmenter").
+- ``multi`` -- the multi-actuator variant: the same U-Net family with a
+  K-channel multi-label head (``ModelConfig.num_classes > 1``; each
+  channel is one actuator class, a pixel joins the union mask when ANY
+  class fires -- ops/pipeline handles C > 1 heads natively now).
+- ``aux``   -- the cheap defect/anomaly auxiliary head: a quarter-width
+  U-Net whose per-frame anomaly score is derived from the confidence
+  margin the fused graph already computes (mean |sigmoid - 0.5|; a
+  model far from its decision boundary across the frame is surprised by
+  its input). Designed to ride along at near-zero marginal cost --
+  exactly the model whose load peaks anti-correlate with the heavy
+  segmenter's and make shared placement pay.
+
+``ServerConfig.zoo_models`` / ``RDP_ZOO_MODELS`` pick the set ("" = the
+default single-model server, bitwise-identical to the pre-zoo path).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+_ZOO_ENV_VAR = "RDP_ZOO_MODELS"
+
+#: the variant an empty wire ``model`` field resolves to
+DEFAULT_MODEL = "seg"
+
+#: head semantics: "segment" serves the mask/curvature contract as-is;
+#: "anomaly" additionally derives a per-frame anomaly score from the
+#: confidence margin and reports it in the response status
+HEADS = ("segment", "anomaly")
+
+
+@dataclass(frozen=True)
+class ModelVariant:
+    """One zoo catalog entry (declaration only; engines are built by the
+    serving layer per generation)."""
+
+    name: str
+    #: registered-model name in the tracking registry; None = the
+    #: server's configured ``ServerConfig.model_name`` (the seed entry)
+    registered_name: str | None
+    #: output channels of the 1x1 head (1 = binary; K > 1 = multi-label
+    #: multi-actuator classes)
+    num_classes: int
+    #: channel-width multiplier on ``ModelConfig.base_features`` --
+    #: sub-1 variants are the cheap ride-along heads
+    width_scale: float
+    head: str
+    description: str
+
+    def model_config(self, base: ModelConfig) -> ModelConfig:
+        """The variant's ModelConfig derived from the serving base
+        config (dtype/norm/init ride along unchanged)."""
+        from robotic_discovery_platform_tpu.utils.config import replace
+
+        features = max(4, int(round(base.base_features * self.width_scale)))
+        return replace(base, num_classes=self.num_classes,
+                       base_features=features)
+
+
+VARIANTS: dict[str, ModelVariant] = {
+    "seg": ModelVariant(
+        name="seg", registered_name=None, num_classes=1, width_scale=1.0,
+        head="segment",
+        description="seed binary actuator segmenter (the default model)",
+    ),
+    "multi": ModelVariant(
+        name="multi", registered_name="Actuator-Segmenter-Multi",
+        num_classes=4, width_scale=1.0, head="segment",
+        description="multi-actuator segmenter: 4-channel multi-label "
+                    "head, union mask over classes",
+    ),
+    "aux": ModelVariant(
+        name="aux", registered_name="Actuator-AuxHead", num_classes=1,
+        width_scale=0.25, head="anomaly",
+        description="cheap defect/anomaly head scoring off the "
+                    "confidence margin",
+    ),
+}
+
+
+def resolve_zoo_models(configured: str) -> tuple[str, ...]:
+    """The effective zoo roster: ``RDP_ZOO_MODELS`` when set, else
+    ``ServerConfig.zoo_models``; a comma-separated list of variant names.
+    Empty = the single default model (the legacy server, bitwise path).
+    The default model is always first (and always present): the empty
+    wire ``model`` field must resolve somewhere."""
+    raw = os.environ.get(_ZOO_ENV_VAR)
+    spec = raw if raw is not None else configured
+    names = [n.strip() for n in (spec or "").split(",") if n.strip()]
+    if not names:
+        return (DEFAULT_MODEL,)
+    unknown = [n for n in names if n not in VARIANTS]
+    if unknown:
+        raise ValueError(
+            f"unknown zoo model(s) {unknown}; catalog: "
+            f"{sorted(VARIANTS)}"
+        )
+    ordered = [DEFAULT_MODEL] + [n for n in names if n != DEFAULT_MODEL]
+    # preserve request order after the pinned default, dropping dups
+    seen: set[str] = set()
+    return tuple(n for n in ordered if not (n in seen or seen.add(n)))
+
+
+def registered_name(variant: ModelVariant, default_model_name: str) -> str:
+    """The registry entry this variant's generations resolve through."""
+    return (variant.registered_name if variant.registered_name is not None
+            else default_model_name)
+
+
+def build_variant_model(variant: ModelVariant, base: ModelConfig):
+    """Build the variant's (uninitialized) Flax module."""
+    from robotic_discovery_platform_tpu.models.unet import build_unet
+
+    return build_unet(variant.model_config(base))
+
+
+def anomaly_score(confidence_margin: float) -> float:
+    """Per-frame defect/anomaly score off the confidence margin: the
+    margin is mean |sigmoid(logit) - 0.5| in [0, 0.5] (0 = every pixel
+    sits on the decision boundary -- the model has no idea what it is
+    looking at; 0.5 = saturated confidence). The score flips that to
+    [0, 1] where 1 = maximally anomalous, so dashboards and the drift
+    monitor read it the intuitive way up."""
+    m = min(max(float(confidence_margin), 0.0), 0.5)
+    return 1.0 - 2.0 * m

@@ -122,6 +122,8 @@ class MetricsServer:
         self._drift_provider = drift_provider
         # same contract for the rollout state machine (serving/rollout.py)
         self._rollout_provider = None
+        # and for the model zoo + placer (serving/zoo.py)
+        self._zoo_provider = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -159,13 +161,24 @@ class MetricsServer:
                         })
                     else:
                         self._send_json(provider())
+                elif path == "/debug/zoo":
+                    provider = outer._zoo_provider
+                    if provider is None:
+                        self._send_json({
+                            "enabled": False,
+                            "reason": "no model zoo attached "
+                                      "(ServerConfig.zoo_models / "
+                                      "RDP_ZOO_MODELS)",
+                        })
+                    else:
+                        self._send_json(provider())
                 elif path == "/debug/profile":
                     self._profile(query)
                 else:
                     self.send_error(
                         404, "try /metrics, /debug/spans, /debug/tracez, "
-                             "/debug/drift, /debug/rollout, or "
-                             "/debug/profile?seconds=N")
+                             "/debug/drift, /debug/rollout, /debug/zoo, "
+                             "or /debug/profile?seconds=N")
 
             def _send_json(self, payload: dict, status: int = 200):
                 body = json.dumps(payload, indent=1).encode("utf-8")
@@ -230,6 +243,12 @@ class MetricsServer:
         manager's :meth:`~robotic_discovery_platform_tpu.serving.rollout.
         RolloutManager.snapshot`)."""
         self._rollout_provider = provider
+
+    def set_zoo_provider(self, provider) -> None:
+        """Install (or clear) the ``GET /debug/zoo`` payload source (a
+        zero-arg callable returning a JSON-able dict -- the servicer's
+        ``zoo_debug``: roster, placement, rate correlations, warm set)."""
+        self._zoo_provider = provider
 
     def start(self) -> "MetricsServer":
         if self._thread is None:

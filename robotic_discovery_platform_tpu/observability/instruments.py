@@ -22,8 +22,10 @@ from robotic_discovery_platform_tpu.observability.registry import REGISTRY
 FRAMES = REGISTRY.counter(
     "rdp_frames_total",
     "Frames handled by the analysis server, by terminal status "
-    "(ok, degraded, error, deadline, shed).",
-    ("status",),
+    "(ok, degraded, error, deadline, shed) and served zoo model "
+    "(models/variants.py; 'seg' is the default binary segmenter, "
+    "'unknown' counts requests naming an unregistered model).",
+    ("status", "model"),
 )
 STAGE_LATENCY = REGISTRY.histogram(
     "rdp_stage_latency_seconds",
@@ -60,15 +62,16 @@ QUANT_PARITY_IOU = REGISTRY.gauge(
     "Mean mask IoU of the reduced-precision serving engine against the "
     "f32 goldens, measured at the warm-up parity check (1.0 at the f32 "
     "tier by definition; serving refuses to start below "
-    "ServerConfig.quant_parity_min_iou).",
+    "ServerConfig.quant_parity_min_iou), per served zoo model.",
+    ("model",),
 )
 QUANT_PARITY_CURV = REGISTRY.gauge(
     "rdp_quant_parity_curvature_err",
     "Absolute curvature delta (1/m) of the reduced-precision engine vs "
-    "the f32 goldens at the warm-up parity check, by stat (mean, max); "
-    "the max drives the startup gate "
+    "the f32 goldens at the warm-up parity check, by stat (mean, max) "
+    "and served zoo model; the max drives the startup gate "
     "(ServerConfig.quant_parity_max_curv_err).",
-    ("stat",),
+    ("stat", "model"),
 )
 
 # -- SLO (observability/slo.py; ServerConfig.slo_ms / RDP_SLO_MS) ------------
@@ -90,8 +93,10 @@ SLO_BURN = REGISTRY.gauge(
     "Error-budget burn rate: sliding-window violation fraction divided "
     "by the budgeted fraction (ServerConfig.slo_budget). Sustained "
     "values > 1 mean the objective is being breached -- the adaptive "
-    "scheduler's retune trigger.",
-    ("objective",),
+    "scheduler's retune trigger. The model label splits the burn per "
+    "served zoo model (model=\"\" is the aggregate the controller and "
+    "fleet consume).",
+    ("objective", "model"),
 )
 
 # -- drift observability (monitoring/profile.py; ServerConfig.drift_*) -------
@@ -100,11 +105,12 @@ DRIFT_SCORE = REGISTRY.gauge(
     "rdp_drift_score",
     "Live-vs-reference population stability index (PSI) per monitored "
     "serving signal (mask_coverage, mean_curvature, max_curvature, "
-    "depth_valid_fraction, confidence_margin), rescored every "
-    "ServerConfig.drift_score_every frames over the sliding live window. "
-    "Sustained values above ServerConfig.drift_psi_threshold fire a "
-    "retrain recommendation.",
-    ("signal",),
+    "depth_valid_fraction, confidence_margin) and served zoo model "
+    "(each zoo entry runs its own DriftMonitor against its own "
+    "reference), rescored every ServerConfig.drift_score_every frames "
+    "over the sliding live window. Sustained values above "
+    "ServerConfig.drift_psi_threshold fire a retrain recommendation.",
+    ("signal", "model"),
 )
 DRIFT_RECOMMENDATIONS = REGISTRY.counter(
     "rdp_drift_recommendations_total",
@@ -192,6 +198,49 @@ ROLLOUT_SKIPPED = REGISTRY.counter(
     "(draining one would leave nothing serving -- the loop never trades "
     "availability for freshness).",
     ("reason",),
+)
+
+# -- model zoo + statistical multiplexing (serving/zoo.py) -------------------
+
+ZOO_MODELS = REGISTRY.gauge(
+    "rdp_zoo_models",
+    "Model-zoo entries this server holds (1 = the legacy single-model "
+    "server; the default binary segmenter is always one of them).",
+)
+MODEL_ARRIVAL_RATE = REGISTRY.gauge(
+    "rdp_model_arrival_rate",
+    "Mean per-model arrival rate (frames/sec) over the ZooPlacer's "
+    "sliding rate window -- the statistical-multiplexing placement "
+    "signal, and the capacity planner's per-model demand input.",
+    ("model",),
+)
+MODEL_CHIPS = REGISTRY.gauge(
+    "rdp_model_chips",
+    "Mesh chips each zoo model is currently placed on (AlpaServe-style "
+    "shared placement co-locates anti-correlated models, so the per-"
+    "model counts sum to MORE than the mesh width under multiplexing; "
+    "a dedicated partition sums exactly to it).",
+    ("model",),
+)
+MODEL_DISPATCHES = REGISTRY.counter(
+    "rdp_model_dispatches_total",
+    "Batched dispatches launched per zoo model (each dispatch carries "
+    "exactly one model's frames).",
+    ("model",),
+)
+ZOO_REBALANCES = REGISTRY.counter(
+    "rdp_zoo_rebalances_total",
+    "ZooPlacer re-placements that CHANGED the model->chips assignment "
+    "(recomputed every ServerConfig.zoo_rebalance_s from the measured "
+    "per-model rate correlations).",
+)
+MODEL_ANOMALY_SCORE = REGISTRY.histogram(
+    "rdp_model_anomaly_score",
+    "Per-frame defect/anomaly score from the aux head (1 - 2 * "
+    "confidence margin: 0 = the model is saturated-confident, 1 = every "
+    "pixel sits on the decision boundary -- the model has never seen "
+    "anything like this frame).",
+    buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
 )
 
 # -- host-path ingest (serving/ingest.py) ------------------------------------

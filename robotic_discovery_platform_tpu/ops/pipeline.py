@@ -135,11 +135,20 @@ def preprocess(frames_rgb, img_size: int):
     return jnp.einsum("Pw,bOwc->bOPc", r_w, x, precision="highest")
 
 
-@shape_contract(logits="b s s 1", out="b h w")
+@shape_contract(logits="b s s c", out="b h w")
 def logits_to_native_masks(logits, h: int, w: int, threshold: float = 0.5):
     """sigmoid > threshold at model resolution, nearest-resize to native
-    [B, H, W] (reference: server.py:122-125)."""
-    prob = jax.nn.sigmoid(logits[..., 0])
+    [B, H, W] (reference: server.py:122-125).
+
+    C > 1 heads (the zoo's multi-actuator variant, models/variants.py)
+    are multi-label: each channel is one actuator class and a pixel
+    joins the union mask when ANY class clears the threshold. The C == 1
+    branch keeps the seed binary expression verbatim -- the default
+    model's graph (and its bitwise-parity guarantee) is untouched."""
+    if logits.shape[-1] == 1:
+        prob = jax.nn.sigmoid(logits[..., 0])
+    else:
+        prob = jnp.max(jax.nn.sigmoid(logits), axis=-1)
     masks = (prob > threshold).astype(jnp.uint8)
     return jax.image.resize(masks, (masks.shape[0], h, w), method="nearest")
 
@@ -161,11 +170,20 @@ def _analyze_batch(model, variables, frames_rgb, depths, intrinsics,
     masks = logits_to_native_masks(logits, h, w, threshold)
     # distance from the decision boundary, at model resolution (XLA CSEs
     # the sigmoid with the one inside logits_to_native_masks; the extra
-    # cost is one [B, S, S] mean riding the existing result fetch)
-    margin = jnp.mean(
-        jnp.abs(jax.nn.sigmoid(logits[..., 0].astype(jnp.float32)) - 0.5),
-        axis=(1, 2),
-    )
+    # cost is one [B, S, S] mean riding the existing result fetch). The
+    # C == 1 branch is the seed expression verbatim; multi-label heads
+    # average the margin over every class channel.
+    if logits.shape[-1] == 1:
+        margin = jnp.mean(
+            jnp.abs(jax.nn.sigmoid(logits[..., 0].astype(jnp.float32))
+                    - 0.5),
+            axis=(1, 2),
+        )
+    else:
+        margin = jnp.mean(
+            jnp.abs(jax.nn.sigmoid(logits.astype(jnp.float32)) - 0.5),
+            axis=(1, 2, 3),
+        )
 
     # The vmapped (dense-batch) leg pins the geometry kernels to the XLA
     # path: batching a pallas_call multiplies its VMEM working set by B

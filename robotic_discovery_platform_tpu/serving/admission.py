@@ -52,6 +52,10 @@ class OverloadedError(RuntimeError):
     RESOURCE_EXHAUSTED)."""
 
 
+#: the estimator key every legacy (un-keyed) observation lands under
+DEFAULT_ESTIMATE_KEY = ("", 0)
+
+
 class ServiceTimeEstimator:
     """Per-frame service-time estimate (one frame's dispatch ride: host
     staging through completed D2H), as the MINIMUM over a sliding window
@@ -61,26 +65,55 @@ class ServiceTimeEstimator:
     bound is also robust to one-off spikes (an XLA compile riding a
     dispatch once poisoned an EWMA here so badly that every later frame
     looked unmeetable). Thread-safe. Zero until the first observation --
-    admission never sheds on a guess it has not earned."""
+    admission never sheds on a guess it has not earned.
+
+    Keyed per ``(model, bucket)``: under a model zoo one global window
+    mixed every model's rides, so a cheap aux-head ride (sub-ms) could
+    drive the minimum down and make the heavy segmenter's deadlines look
+    meetable (never shed, queue grows) -- or the segmenter's rides could
+    make the aux head's generous deadlines look doomed. ``s_for(model)``
+    answers the admission question per model (best case over that
+    model's buckets only); the legacy ``.s`` property is the minimum
+    over everything, exactly the old single-model behavior when only one
+    model observes."""
 
     def __init__(self, window: int = 16):
         self._lock = checked_lock("admission.estimator")
-        self._window: deque[float] = deque(maxlen=max(1, int(window)))  # guarded_by: _lock
+        self._maxlen = max(1, int(window))
+        self._windows: dict[tuple, deque[float]] = {}  # guarded_by: _lock
         self._n = 0  # guarded_by: _lock
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float,
+                key: tuple | None = None) -> None:
+        """One completed ride; ``key`` is ``(model, bucket)`` (None = the
+        legacy un-keyed bucket)."""
         if seconds < 0:
             return
+        key = DEFAULT_ESTIMATE_KEY if key is None else key
         with self._lock:
             self._n += 1
-            self._window.append(float(seconds))
+            win = self._windows.get(key)
+            if win is None:
+                win = self._windows[key] = deque(maxlen=self._maxlen)
+            win.append(float(seconds))
+
+    def s_for(self, model: str = "") -> float:
+        """Best-case service time over ``model``'s keys only (0 = that
+        model has no completed rides yet -- admission never sheds a
+        model on another model's history)."""
+        with self._lock:
+            mins = [min(w) for k, w in self._windows.items()
+                    if k[0] == model and w]
+        return min(mins) if mins else 0.0
 
     @property
     def s(self) -> float:
         """Best-case per-frame service time in seconds over the recent
-        window (0 = no observations yet)."""
+        window of EVERY key (0 = no observations yet) -- the pre-zoo
+        single-model semantics."""
         with self._lock:
-            return min(self._window) if self._window else 0.0
+            mins = [min(w) for w in self._windows.values() if w]
+        return min(mins) if mins else 0.0
 
     @property
     def observations(self) -> int:

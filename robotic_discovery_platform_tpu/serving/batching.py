@@ -291,6 +291,14 @@ class DeviceRouter:
         self.on_health = on_health
         self._qlock = checked_lock("batching.router.quarantine")
         self._quarantined: set[int] = set()  # guarded_by: _qlock
+        # distinct models whose dispatches failed on each chip since its
+        # last success: a single model failing deterministically is a
+        # MODEL bug (its frames fail over / error), not a chip fault --
+        # only failures spanning >= 2 models (or a single-model
+        # dispatcher's failures) feed the quarantine breaker, so one bad
+        # zoo model can never quarantine healthy silicon out from under
+        # its neighbors
+        self._fail_models: dict[int, set[str]] = {}  # guarded_by: _qlock
         #: chips quarantined since construction (monotone; the gauge is
         #: the live set size)
         self.quarantines_total = 0  # guarded_by: _qlock
@@ -361,14 +369,36 @@ class DeviceRouter:
                 return i
         return None
 
+    def failure_confined(self, chip: int, model: str) -> bool:
+        """True when every recorded failure on ``chip`` since its last
+        success came from ``model`` alone -- the signature of a broken
+        MODEL rather than a broken chip. The dispatcher uses this to cut
+        the failover budget to one attempt: ricocheting a deterministic
+        model error around the whole ring starves the healthy models'
+        frames behind it in the queue for nothing."""
+        with self._qlock:
+            fails = self._fail_models.get(chip)
+            return fails is not None and fails == {model}
+
     def record_result(self, chip: int, ok: bool,
-                      exc: BaseException | None = None) -> None:
+                      exc: BaseException | None = None,
+                      model: str = "", multi_model: bool = False) -> None:
         """Feed one dispatch outcome on ``chip`` into its breaker and
-        apply the quarantine/reinstatement transition it implies."""
+        apply the quarantine/reinstatement transition it implies.
+
+        ``model``/``multi_model``: under a model zoo, a failure only
+        counts toward the CHIP breaker when failures on that chip span
+        more than one model (or the dispatcher serves a single model --
+        the pre-zoo semantics): a chip that fails model A's dispatches
+        while completing model B's is running a broken MODEL, and
+        quarantining it would amplify one tenant's bug into mesh-wide
+        capacity loss for every other tenant."""
         if not self.quarantine_enabled or not (0 <= chip < len(self.ring)):
             return
         breaker = self.breakers[chip]
         if ok:
+            with self._qlock:
+                self._fail_models.pop(chip, None)
             breaker.record_success()
             with self._qlock:
                 reinstated = chip in self._quarantined
@@ -382,9 +412,17 @@ class DeviceRouter:
                     self.on_health(chip, True)
             return
         with self._qlock:
+            fails = self._fail_models.setdefault(chip, set())
+            fails.add(model)
+            chip_level = not multi_model or len(fails) >= 2
             already = chip in self._quarantined
             last_healthy = (not already
                             and len(self._quarantined) >= len(self.ring) - 1)
+        if not chip_level:
+            # one model failing alone on this chip: its frames fail over
+            # or error (the caller handles that); the chip's breaker
+            # never hears about it
+            return
         if last_healthy:
             # never quarantine the last chip: a degraded mesh still
             # serves; breaker state is left untouched so a recovered
@@ -424,6 +462,11 @@ class _Pending:
     depth: np.ndarray
     intrinsics: np.ndarray
     depth_scale: float
+    #: zoo model key this frame rides ("" = the default model; the
+    #: collector groups by (model, geometry), so one dispatch only ever
+    #: carries one model's frames -- per-model fault isolation is
+    #: structural, not checked)
+    model: str = ""
     done: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: BaseException | None = None
@@ -511,6 +554,11 @@ class _Dispatch:
     # switches mid-flight must not misattribute a sharded dispatch's
     # outcome to chip 0's quarantine breaker
     mode: str = "single"
+    # which zoo model this dispatch carries ("" = default) and the padded
+    # bucket it launched as: the completer's service-time sample is keyed
+    # per (model, bucket) so models never poison each other's estimates
+    model: str = ""
+    bucket: int = 0
     # when host staging began (seconds); the completer derives the
     # per-frame service-time estimate from staged_t -> completion
     staged_t: float = 0.0
@@ -537,6 +585,18 @@ def _bucket(n: int, max_batch: int) -> int:
     while b < n:
         b *= 2
     return min(b, max_batch)
+
+
+@dataclass(eq=False)
+class _ModelBinding:
+    """How the dispatcher reaches one non-default zoo model: the shared
+    batched analyzer (already closed over that model's variables), plus
+    optional per-chip replicas / a mesh-sharded variant mirroring the
+    default model's DeviceRouter bindings."""
+
+    analyze_batch: Callable
+    per_chip: list | None = None
+    sharded: Callable | None = None
 
 
 class BatchDispatcher:
@@ -577,6 +637,14 @@ class BatchDispatcher:
             (observability/recorder.py); defaults to the process-global
             ``RECORDER`` behind ``GET /debug/spans``. Tests inject a
             private one.
+        placer: optional :class:`~robotic_discovery_platform_tpu.serving.
+            zoo.ZooPlacer`; when set, each model's dispatches are
+            restricted to its placed chips (``chips_for``) and every
+            submit records an arrival into the placer's per-model rate
+            windows. None (default) keeps the placement-free routing.
+        model_label: display name of the DEFAULT model ("" key) in fault
+            sites / metrics / placer keys -- the zoo's default entry
+            name ("seg"); "default" when unset.
     """
 
     def __init__(self, analyze_batch: Callable, window_ms: float = 2.0,
@@ -586,10 +654,27 @@ class BatchDispatcher:
                  max_inflight: int = 2,
                  router: DeviceRouter | None = None,
                  admission: str = "deadline",
-                 flight_recorder: recorder_lib.FlightRecorder | None = None):
+                 flight_recorder: recorder_lib.FlightRecorder | None = None,
+                 placer=None, model_label: str = "default"):
         self._analyze = analyze_batch
         self._recorder = (flight_recorder if flight_recorder is not None
                           else recorder_lib.RECORDER)
+        self._placer = placer
+        self._model_label = model_label or "default"
+        # per-model dispatch bindings beyond the default ("" rides the
+        # legacy analyzer/router construction untouched): name ->
+        # _ModelBinding, bound by the serving layer per zoo generation.
+        # Written only before serving starts (bind_model) -- reads on the
+        # collector hot path are lock-free dict lookups.
+        self._bindings: dict[str, _ModelBinding] = {}
+        # (model, placement, bucket) combos whose batched graph has been
+        # compiled (by eager warm-up OR the first lazy dispatch):
+        # warming M x chips x buckets eagerly would explode startup, so
+        # the serving layer eagerly warms a capped subset and everything
+        # else compiles on first use -- this set is how tests and
+        # /debug/zoo see which is which
+        self.warmed: set[tuple] = set()  # guarded_by: _warm_lock
+        self._warm_lock = checked_lock("batching.warmset")
         self._window_s = window_ms / 1e3
         self._max_batch = max_batch
         self._max_backlog = max_backlog
@@ -606,8 +691,10 @@ class BatchDispatcher:
         # of doomed frames) can never starve the signal that refreshes
         # the estimate. Collector increments, completer resets: two
         # threads, so the counter rides the inflight lock (racecheck
-        # RC002 surfaced the bare read-modify-write here).
-        self._sheds_since_complete = 0  # guarded_by: _inflight_lock
+        # RC002 surfaced the bare read-modify-write here). Keyed per
+        # model alongside the estimator keys: model A shedding must not
+        # burn (or reset) model B's probe budget.
+        self._sheds_since_complete: dict[str, int] = {}  # guarded_by: _inflight_lock
         #: multiplier on the service estimate when deciding a deadline is
         #: unmeetable; the controller's brownout ladder raises it to shed
         #: earlier at admission (level 2), 1.0 = only shed truly doomed
@@ -709,12 +796,37 @@ class BatchDispatcher:
 
     # -- caller side --------------------------------------------------------
 
+    def bind_model(self, name: str, analyze_batch: Callable,
+                   per_chip_analyzers=None, sharded_analyzer=None) -> None:
+        """Register one non-default zoo model's batched analyzers so
+        ``submit(model=name)`` can route to it. Call before serving that
+        model (the serving layer binds the whole zoo at engine build)."""
+        if not name:
+            raise ValueError("the default model is bound at construction")
+        self._bindings[name] = _ModelBinding(
+            analyze_batch,
+            per_chip=(list(per_chip_analyzers)
+                      if per_chip_analyzers is not None else None),
+            sharded=sharded_analyzer,
+        )
+
+    def bound_models(self) -> tuple[str, ...]:
+        """Every model key this dispatcher routes ("" = default)."""
+        return ("", *self._bindings)
+
+    def _display_model(self, model: str) -> str:
+        return model or self._model_label
+
     @shape_contract(frame_rgb=("h w 3", "uint8"), depth="h w",
                     intrinsics="3 3")
     def submit(self, frame_rgb, depth, intrinsics, depth_scale,
-               timeout_s: float | None = None):
+               timeout_s: float | None = None, model: str = ""):
         """Block until this frame's analysis is available; returns the
         unbatched FrameAnalysis slice (host numpy leaves).
+
+        ``model`` selects a bound zoo model ("" = the default engine,
+        the pre-zoo contract); frames only ever batch with their own
+        model's co-arrivals.
 
         Raises :class:`OverloadedError` when the backlog cap is hit (or
         this frame was evicted at the cap by a newer frame with more
@@ -722,11 +834,18 @@ class BatchDispatcher:
         misses the submit deadline (``timeout_s`` if given and tighter,
         else ``submit_timeout_s``).
         """
+        if model and model not in self._bindings:
+            raise ValueError(
+                f"unknown model {model!r}; bound: {self.bound_models()}"
+            )
+        if self._placer is not None:
+            self._placer.record_arrival(self._display_model(model))
         timeout = self._submit_timeout_s
         if timeout_s is not None:
             timeout = min(timeout, timeout_s)
         p = _Pending(frame_rgb, depth, _intrinsics_f32(intrinsics),
-                     float(depth_scale), trace_ctx=trace.current(),
+                     float(depth_scale), model=model,
+                     trace_ctx=trace.current(),
                      deadline_t=time.monotonic() + timeout)
         # enqueue under the lock stop() drains under: a submit either lands
         # BEFORE the drain (and is error-completed by it) or observes
@@ -739,7 +858,8 @@ class BatchDispatcher:
                 self._pending.add(p)
             try:
                 self._q.put(
-                    p, margin_s=self.service_estimate.s * self.deadline_safety
+                    p, margin_s=(self.service_estimate.s_for(model)
+                                 * self.deadline_safety)
                 )
             except OverloadedError:
                 with self._pending_lock:
@@ -942,7 +1062,7 @@ class BatchDispatcher:
                 with self._inflight_lock:
                     self._inflight_count = 0
                     self._chip_inflight = [0] * self._n_windows
-                    self._sheds_since_complete = 0
+                    self._sheds_since_complete.clear()
                     obs.INFLIGHT_DISPATCHES.set(0)
                     for chip in range(self._n_windows):
                         obs.CHIP_INFLIGHT.labels(chip=str(chip)).set(0)
@@ -969,16 +1089,23 @@ class BatchDispatcher:
                 self._pending.discard(p)
             return False
         if p.deadline_t is not None and self._q.policy == "deadline":
-            est = self.service_estimate.s * self.deadline_safety
+            # per-model estimate (admission.py): a cheap aux ride cannot
+            # make the segmenter's deadlines look meetable, nor the
+            # reverse -- each model sheds on its own history only
+            est = self.service_estimate.s_for(p.model) * self.deadline_safety
             slack = p.deadline_t - time.monotonic()
             if est > 0 and slack < est:
                 with self._inflight_lock:
-                    if self._sheds_since_complete >= 8:
+                    if self._sheds_since_complete.get(p.model, 0) >= 8:
                         # probe-through: admit this frame despite the
                         # verdict so its ride refreshes the service
-                        # estimate (the completer resets the counter)
+                        # estimate (the completer resets the counter);
+                        # the valve is per model, like the estimate it
+                        # exists to refresh
                         return True
-                    self._sheds_since_complete += 1
+                    self._sheds_since_complete[p.model] = (
+                        self._sheds_since_complete.get(p.model, 0) + 1
+                    )
                 obs.SHED_BY_DEADLINE.labels(point="stale").inc()
                 self._fail_group([p], DeadlineExceeded(
                     f"deadline unmeetable: ~{est * 1e3:.0f}ms estimated "
@@ -1025,10 +1152,15 @@ class BatchDispatcher:
             # failure mode the watchdog exists for
             inject("serving.batch.collect")
             collected_ns = time.monotonic_ns()
-            by_shape: dict[tuple, list[_Pending]] = {}
+            # group by (model, geometry): a dispatch is single-model by
+            # construction, so one model's chip fault can only ever fail
+            # its own frames (per-model fault isolation)
+            by_key: dict[tuple, list[_Pending]] = {}
             for p in batch:
-                by_shape.setdefault(p.frame_rgb.shape[:2], []).append(p)
-            for group in by_shape.values():
+                by_key.setdefault(
+                    (p.model, p.frame_rgb.shape[:2]), []
+                ).append(p)
+            for group in by_key.values():
                 self._launch_group(group, collected_ns)
 
     def _pool_take(self, key: tuple, template: _Pending) -> _BucketBuffers:
@@ -1058,12 +1190,24 @@ class BatchDispatcher:
 
     # -- mesh routing --------------------------------------------------------
 
-    def _pick_chip(self) -> int:
+    def _allowed_chips(self, model: str) -> set[int] | None:
+        """The placer's chip set for ``model`` (None = unrestricted).
+        An empty/exhausted placement falls back to unrestricted: a
+        placement is a throughput preference, never an availability
+        constraint."""
+        if self._placer is None:
+            return None
+        allowed = set(self._placer.chips_for(self._display_model(model)))
+        allowed &= set(range(self._n_windows))
+        return allowed or None
+
+    def _pick_chip(self, model: str = "") -> int:
         """The ring index the next dispatch launches on: the least-loaded
-        HEALTHY chip by current in-flight count, ties walking the ring
-        from the cursor (so an idle mesh round-robins and a skewed one
-        heals). A quarantined chip whose half-open breaker admits a probe
-        takes the dispatch instead -- that dispatch IS the probe, and its
+        HEALTHY chip -- within the model's placed set when a ZooPlacer is
+        wired -- by current in-flight count, ties walking the ring from
+        the cursor (so an idle mesh round-robins and a skewed one heals).
+        A quarantined chip whose half-open breaker admits a probe takes
+        the dispatch instead -- that dispatch IS the probe, and its
         outcome decides reinstatement. Sharded dispatches always ride
         window 0 (one window spanning every chip)."""
         r = self._router
@@ -1071,23 +1215,35 @@ class BatchDispatcher:
             return 0
         if self._n_windows == 1:
             return 0
+        allowed = self._allowed_chips(model)
         if r is not None and r.quarantine_enabled:
             probe = r.probe_candidate()
-            if probe is not None:
+            if probe is not None and (allowed is None or probe in allowed):
                 log.info("routing probe dispatch to quarantined chip %d",
                          probe)
                 return probe
             healthy = set(r.healthy_chips())
+            placeable = (healthy if allowed is None
+                         else (healthy & allowed) or healthy)
             with self._inflight_lock:
                 loads = [
-                    self._chip_inflight[i] if i in healthy else float("inf")
+                    self._chip_inflight[i] if i in placeable
+                    else float("inf")
                     for i in range(self._n_windows)
                 ]
                 chip = mesh_lib.least_loaded(loads, self._rr_next)
                 self._rr_next = (chip + 1) % self._n_windows
             return chip
         with self._inflight_lock:
-            chip = mesh_lib.least_loaded(self._chip_inflight, self._rr_next)
+            if allowed is None:
+                loads = self._chip_inflight
+            else:
+                loads = [
+                    self._chip_inflight[i] if i in allowed
+                    else float("inf")
+                    for i in range(self._n_windows)
+                ]
+            chip = mesh_lib.least_loaded(loads, self._rr_next)
             self._rr_next = (chip + 1) % self._n_windows
         return chip
 
@@ -1101,7 +1257,19 @@ class BatchDispatcher:
             return self._router.sharding
         return self._router.ring[chip]
 
-    def _analyze_for(self, chip: int) -> Callable:
+    def _analyze_for(self, chip: int, model: str = "") -> Callable:
+        if model:
+            # non-default zoo model: its binding mirrors the default
+            # model's router layout (per-chip replicas / sharded copy),
+            # falling back to the shared closure when a layout was not
+            # bound
+            b = self._bindings[model]
+            r = self._router
+            if r is not None and r.mode == "sharded":
+                return (b.sharded if b.sharded is not None
+                        else b.analyze_batch)
+            a = b.per_chip
+            return a[min(chip, len(a) - 1)] if a else b.analyze_batch
         r = self._router
         if r is None:
             return self._analyze
@@ -1122,33 +1290,47 @@ class BatchDispatcher:
             b = min(max(b, self._router.chips), self._max_batch)
         return b
 
-    def warm(self, frames, depths, intrinsics, scales) -> None:
-        """Compile + run the analyzer for this batch shape on EVERY routed
-        placement, blocking until done: warm-up and hot-reload
-        pre-compilation route through here so the first real frame on any
-        chip (or under the sharded layout) never pays XLA compilation.
-        A mode-switchable router warms BOTH layouts, so a controller mode
-        flip mid-burst never stalls on a compile."""
+    def warm(self, frames, depths, intrinsics, scales,
+             model: str = "", chips=None) -> None:
+        """Compile + run ``model``'s analyzer for this batch shape,
+        blocking until done: warm-up and hot-reload pre-compilation
+        route through here so a warmed (model, placement, bucket) never
+        pays XLA compilation on a live frame.
+
+        ``chips=None`` warms EVERY routed placement (the default model's
+        historical eager warm; a mode-switchable router warms BOTH
+        layouts so a controller mode flip mid-burst never stalls on a
+        compile). An explicit chip list is the zoo's CAPPED eager warm:
+        extra models warm one home placement each and everything else
+        compiles lazily on its first dispatch -- eagerly warming
+        M x chips x buckets would explode startup."""
         r = self._router
-        placements: list[tuple[Any, Callable]] = []
+        b = len(frames)
+        placements: list[tuple[Any, Callable, Any]] = []
         if r is not None and r.mode == "sharded":
-            placements.append((r.sharding, self._analyze_for(0)))
+            placements.append((r.sharding, self._analyze_for(0, model),
+                               None))
         else:
-            for chip in range(self._n_windows):
+            for chip in (range(self._n_windows) if chips is None
+                         else chips):
                 placements.append(
-                    (self._placement(chip), self._analyze_for(chip))
+                    (self._placement(chip),
+                     self._analyze_for(chip, model), chip)
                 )
-        if (r is not None and r.can_switch_modes
+        if (chips is None and r is not None and r.can_switch_modes
                 and len(frames) % r.chips == 0):
-            other = ((r.sharding, r.sharded_analyzer)
-                     if r.mode == "round_robin" else None)
-            if other is not None:
-                placements.append(other)
-        for device, analyze in placements:
+            if r.mode == "round_robin":
+                other = (r.sharded_analyzer if not model
+                         else self._bindings[model].sharded)
+                if other is not None:
+                    placements.append((r.sharding, other, None))
+        for device, analyze, key in placements:
             staged = pipeline_lib.stage_batch(
                 frames, depths, intrinsics, scales, device=device
             )
             jax.block_until_ready(analyze(*staged))
+            with self._warm_lock:
+                self.warmed.add((model, key, b))
 
     def _stage_group(self, group: list[_Pending], b: int):
         """Host-side staging: the padded [b, ...] batch arrays for a group.
@@ -1181,11 +1363,13 @@ class BatchDispatcher:
         blocks on the result."""
         if collected_ns is None:
             collected_ns = time.monotonic_ns()
+        model = group[0].model
         # bounded in-flight window, per routed chip: dispatch N+1 on a chip
         # may not launch until one of THAT chip's slots frees (at most
         # max_inflight batches hold each chip's device memory). The pick is
-        # least-loaded, so blocking here means every chip's window is full.
-        chip = self._pick_chip()
+        # least-loaded within the model's placed chips, so blocking here
+        # means every chip this model may use has a full window.
+        chip = self._pick_chip(model)
         slot = self._chip_slots[chip]
         while not slot.acquire(timeout=0.05):
             if self._stopped.is_set():
@@ -1203,6 +1387,7 @@ class BatchDispatcher:
         tl = recorder_lib.Timeline("dispatch", labels={
             "chip": str(chip),
             "mode": mode,
+            "model": self._display_model(model),
         })
         root = tl.span("dispatch", start_ns=first_submit_ns)
         tl.span("collect", start_ns=first_submit_ns, end_ns=collected_ns,
@@ -1223,6 +1408,11 @@ class BatchDispatcher:
             # slows exactly one chip's dispatches -- the quarantine and
             # failover drill, no code changes needed
             inject(f"serving.chip.{chip}.dispatch")
+            # per-model fault site: kills exactly one zoo model's
+            # dispatches (groups are single-model, so another model's
+            # frames can never ride -- and never fail -- this launch);
+            # the multimodel-smoke cross-model-isolation drill
+            inject(f"serving.model.{self._display_model(model)}.dispatch")
             n = len(group)
             obs.BATCH_SIZE.observe(n)
             self.recent_batch += 0.25 * (n - self.recent_batch)
@@ -1241,8 +1431,13 @@ class BatchDispatcher:
             )
             t1 = time.monotonic_ns()
             # jit async dispatch: returns once the computation is enqueued
-            out = self._analyze_for(chip)(*staged)
+            # (an unwarmed (model, chip, bucket) pays its XLA compile
+            # here -- the capped-warmup contract: lazy by default)
+            out = self._analyze_for(chip, model)(*staged)
             t2 = time.monotonic_ns()
+            warm_key = (model, None if mode == "sharded" else chip, b)
+            with self._warm_lock:
+                self.warmed.add(warm_key)
             tl.span("stage", start_ns=t0, end_ns=t1, parent=root)
             tl.span("launch", start_ns=t1, end_ns=t2, parent=root)
             obs.BATCH_STAGE_LATENCY.labels(stage="stage").observe(
@@ -1275,8 +1470,11 @@ class BatchDispatcher:
                 )
             obs.CHIP_DISPATCHES.labels(chip=str(chip)).inc()
             obs.CHIP_FRAMES.labels(chip=str(chip)).inc(n)
+            obs.MODEL_DISPATCHES.labels(
+                model=self._display_model(model)).inc()
             self._cq.put(_Dispatch(group, out, bufs, slot, t2 / 1e9, chip,
-                                   mode=mode, staged_t=t0 / 1e9,
+                                   mode=mode, model=model, bucket=b,
+                                   staged_t=t0 / 1e9,
                                    timeline=tl, root=root))
             launched = True
         except BaseException as exc:  # deliver, don't kill the collector
@@ -1299,9 +1497,12 @@ class BatchDispatcher:
         failing chip once its breaker opens. Frames out of failover
         budget (or abandoned, or under a non-quarantining router) get the
         error, exactly the old behavior."""
+        model = group[0].model if group else ""
         r = self._router
         if r is not None and mode == "round_robin":
-            r.record_result(chip, ok=False, exc=exc)
+            r.record_result(chip, ok=False, exc=exc,
+                            model=self._display_model(model),
+                            multi_model=bool(self._bindings))
         can_failover = (r is not None and r.quarantine_enabled
                         and mode == "round_robin"
                         and not self._stopped.is_set())
@@ -1310,6 +1511,9 @@ class BatchDispatcher:
             return
         retry, doomed = [], []
         budget = r.chips + 1
+        if (self._bindings
+                and r.failure_confined(chip, self._display_model(model))):
+            budget = 1
         for p in group:
             if (p.done.is_set() or p.abandoned or p.failovers >= budget):
                 doomed.append(p)
@@ -1345,19 +1549,26 @@ class BatchDispatcher:
                     p.result = jax.tree.map(lambda a, _i=i: a[_i], host)
                     p.done.set()
                 # one completed ride = one per-frame service-time sample
-                # (staging through D2H): what the admission shed and the
-                # eviction margin consult
+                # (staging through D2H), keyed per (model, bucket): what
+                # the admission shed and the eviction margin consult --
+                # and a cheap model's ride can no longer poison an
+                # expensive model's estimate
                 if d.staged_t > 0:
                     self.service_estimate.observe(
-                        time.monotonic() - d.staged_t
+                        time.monotonic() - d.staged_t,
+                        key=(d.model, d.bucket),
                     )
                 with self._inflight_lock:
-                    self._sheds_since_complete = 0
+                    self._sheds_since_complete[d.model] = 0
                 if self._router is not None and d.mode == "round_robin":
                     # a completed dispatch is the chip's success signal --
                     # and a quarantined chip's successful PROBE, which
                     # reinstates it
-                    self._router.record_result(d.chip, ok=True)
+                    self._router.record_result(
+                        d.chip, ok=True,
+                        model=self._display_model(d.model),
+                        multi_model=bool(self._bindings),
+                    )
             except BaseException as exc:  # deliver, keep draining
                 if d.timeline is not None:
                     d.timeline.fail(exc)

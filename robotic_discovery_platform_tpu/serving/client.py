@@ -49,7 +49,8 @@ class FrameResult:
 
 
 def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
-                   fmt: str = "encoded") -> vision_pb2.AnalysisRequest:
+                   fmt: str = "encoded",
+                   model: str = "") -> vision_pb2.AnalysisRequest:
     """Build one wire request from a BGR frame + z16 depth frame.
 
     ``fmt="encoded"`` (default) is the historical JPEG/PNG pair (lossy
@@ -57,7 +58,11 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
     ``fmt="raw"`` sends the fleet-internal fast path instead: raw RGB8 /
     little-endian z16 payloads with ``Image.format = 1``, which the
     server maps as zero-copy views and never runs through ``imdecode``
-    (serving/ingest.py) -- more ingress bytes, near-zero server decode."""
+    (serving/ingest.py) -- more ingress bytes, near-zero server decode.
+
+    ``model`` selects the model-zoo entry by name (serving/zoo.py);
+    "" (default) is the server's default model, and serializes to ZERO
+    extra wire bytes -- a legacy request is bitwise identical."""
     import cv2
 
     h, w = color_bgr.shape[:2]
@@ -75,6 +80,7 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
                 data=z16.tobytes(), width=w, height=h,
                 format=ingest.FORMAT_RAW,
             ),
+            model=model,
         )
     if fmt != "encoded":
         raise ValueError(f"unknown request format {fmt!r}; "
@@ -86,6 +92,7 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
     return vision_pb2.AnalysisRequest(
         color_image=vision_pb2.Image(data=jpg.tobytes(), width=w, height=h),
         depth_image=vision_pb2.Image(data=png.tobytes(), width=w, height=h),
+        model=model,
     )
 
 

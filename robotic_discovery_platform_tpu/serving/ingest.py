@@ -312,6 +312,9 @@ class IngestFrame:
     #: the decode itself; pooled: the wait, ~0 when prefetch won the race)
     wait_s: float
     fmt: str = "encoded"
+    #: the request's zoo model selector ("" = default model) -- read off
+    #: the wire before decode, so even an errored frame is attributed
+    model: str = ""
 
 
 class DecodePool:
@@ -548,7 +551,8 @@ class DecodePool:
                 t0 = time.perf_counter()
                 p = self.submit(request)
                 yield IngestFrame(p.rgb, p.depth, p.error, remaining,
-                                  time.perf_counter() - t0, p.fmt)
+                                  time.perf_counter() - t0, p.fmt,
+                                  model=request.model)
             return
         yield from self._iter_pooled(request_iterator, active,
                                      time_remaining)
@@ -610,7 +614,8 @@ class DecodePool:
                 # frame completes long before either)
                 self.wait(p, remaining if remaining is not None else 60.0)
                 yield IngestFrame(p.rgb, p.depth, p.error, remaining,
-                                  time.perf_counter() - t0, p.fmt)
+                                  time.perf_counter() - t0, p.fmt,
+                                  model=p.request.model)
         finally:
             stream_done.set()
             # best-effort join; a pump blocked in the gRPC iterator read

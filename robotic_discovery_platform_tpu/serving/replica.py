@@ -46,16 +46,25 @@ _PKG_ROOT = str(Path(__file__).resolve().parents[2])
 
 
 def register_tiny_model(root: Path, *, img_size: int = 64,
-                        base_features: int = 8, seed: int = 0) -> str:
-    """Create a file-store registry under ``root`` holding one tiny
-    registered model (staging-aliased) every replica of a local CPU fleet
-    serves -- shared weights are what make the 1-replica fleet path
-    bitwise-comparable to a direct server. Returns the tracking URI.
-    Refactored out of bench_load.boot_smoke_server so fleets, benches,
-    and tests build identical registries."""
+                        base_features: int = 8, seed: int = 0,
+                        models: tuple[str, ...] = ("seg",)) -> str:
+    """Create a file-store registry under ``root`` holding tiny
+    registered models (staging-aliased) every replica of a local CPU
+    fleet serves -- shared weights are what make the 1-replica fleet
+    path bitwise-comparable to a direct server. Returns the tracking
+    URI. Refactored out of bench_load.boot_smoke_server so fleets,
+    benches, and tests build identical registries.
+
+    ``models`` picks zoo variants from the models/variants.py catalog;
+    each gets its own registry entry under its registered name (the
+    default "seg" keeps the historical single-entry registry
+    byte-for-byte)."""
     import jax
 
     from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.models import (
+        variants as variants_lib,
+    )
     from robotic_discovery_platform_tpu.models.unet import (
         build_unet,
         init_unet,
@@ -67,17 +76,23 @@ def register_tiny_model(root: Path, *, img_size: int = 64,
     uri = f"file:{root}"
     tracking.set_tracking_uri(uri)
     tracking.set_experiment("Actuator Segmentation")
-    mcfg = ModelConfig(base_features=base_features,
+    base = ModelConfig(base_features=base_features,
                        compute_dtype="float32")
-    model = build_unet(mcfg)
-    variables = init_unet(model, jax.random.key(seed), img_size=img_size)
-    with tracking.start_run():
-        version = tracking.log_model(
-            variables, mcfg, registered_model_name="Actuator-Segmenter"
+    for i, name in enumerate(models):
+        variant = variants_lib.VARIANTS[name]
+        mcfg = variant.model_config(base)
+        reg_name = variants_lib.registered_name(
+            variant, "Actuator-Segmenter")
+        model = build_unet(mcfg)
+        variables = init_unet(model, jax.random.key(seed + i),
+                              img_size=img_size)
+        with tracking.start_run():
+            version = tracking.log_model(
+                variables, mcfg, registered_model_name=reg_name
+            )
+        tracking.Client().set_registered_model_alias(
+            reg_name, "staging", version
         )
-    tracking.Client().set_registered_model_alias(
-        "Actuator-Segmenter", "staging", version
-    )
     return uri
 
 

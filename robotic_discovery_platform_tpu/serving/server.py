@@ -121,6 +121,7 @@ import numpy as np
 
 from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.io.frames import load_calibration
+from robotic_discovery_platform_tpu.models import variants as variants_lib
 from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
 from robotic_discovery_platform_tpu.observability import (
     exposition,
@@ -142,6 +143,7 @@ from robotic_discovery_platform_tpu.serving import (
     health as health_lib,
     ingest as ingest_lib,
     rollout as rollout_lib,
+    zoo as zoo_lib,
 )
 from robotic_discovery_platform_tpu.ops.pallas import quant
 from robotic_discovery_platform_tpu.serving.batching import (
@@ -254,6 +256,9 @@ class _FrameResult(NamedTuple):
     valid: bool
     confidence_margin: float
     depth_valid_fraction: float
+    #: the aux head's defect/anomaly score (None for "segment" heads --
+    #: i.e. always None on the default model's bitwise path)
+    anomaly: float | None = None
 
 
 class Engine(NamedTuple):
@@ -321,6 +326,15 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             )
         if self.precision != "f32":
             log.info("serving precision tier: %s", self.precision)
+        # Model zoo roster (serving/zoo.py + models/variants.py): the
+        # named engine generations this server holds side by side. The
+        # empty roster is the legacy single-model server -- one entry
+        # (the seed segmenter), no placer, serving path bitwise
+        # identical to pre-zoo. The default entry's NAME labels every
+        # per-model metric even on legacy servers.
+        self._zoo_names = variants_lib.resolve_zoo_models(cfg.zoo_models)
+        self.model_label = self._zoo_names[0]
+        obs.ZOO_MODELS.set(len(self._zoo_names))
         self._serving_mesh = None
         chips = resolve_serving_chips(cfg.serving_mesh)
         if cfg.batch_window_ms > 0 and chips > 1:
@@ -349,6 +363,24 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # readers are every handler thread.
         self._refusing_streams = False  # guarded_by: _streams_cond
         self._brownout_tick = 0  # guarded_by: _streams_cond
+        # ZooPlacer (statistical multiplexing): built BEFORE the engine
+        # so the dispatcher can consult it per launch. Only a real
+        # multi-model zoo pays for one; the legacy server routes exactly
+        # as before.
+        self.placer: zoo_lib.ZooPlacer | None = None
+        if len(self._zoo_names) > 1:
+            self.placer = zoo_lib.ZooPlacer(
+                self._zoo_names,
+                chips=max(1, chips if self._serving_mesh is not None else 1),
+                mode=zoo_lib.resolve_zoo_placement(cfg.zoo_placement),
+                interval_s=cfg.zoo_rate_interval_s,
+                window=cfg.zoo_rate_window,
+                rebalance_s=cfg.zoo_rebalance_s,
+                corr_cap=cfg.zoo_corr_cap,
+            )
+            log.info("model zoo: %s (%s placement over %d chip(s))",
+                     ",".join(self._zoo_names), self.placer.mode,
+                     self.placer.chips)
         self._engine = self._make_engine(model, variables, version)
         self._warm_shape: tuple[int, int] | None = None
         self._reload_stop: threading.Event | None = None
@@ -418,7 +450,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 slo_ms / 1e3, budget=cfg.slo_budget, window=cfg.slo_window,
                 name="e2e",
                 violations=obs.SLO_VIOLATIONS.labels(objective="e2e"),
-                burn_gauge=obs.SLO_BURN.labels(objective="e2e"),
+                # model="" = the all-models aggregate: what the reactive
+                # controller and the fleet front-end consume; per-model
+                # burn children ride next to it under a zoo
+                burn_gauge=obs.SLO_BURN.labels(objective="e2e", model=""),
                 objective_gauge=obs.SLO_OBJECTIVE.labels(objective="e2e"),
             )
             log.info("SLO tracking: %.1f ms objective, %.2f%% budget",
@@ -473,6 +508,20 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 "%s) and batch_window_ms > 0 (got %s)",
                 cfg.slo_ms, cfg.batch_window_ms,
             )
+        # Model zoo entries (serving/zoo.py): the default entry is this
+        # server's legacy engine state under its catalog name; extras
+        # are built from their own registry entries and bound onto the
+        # SHARED dispatcher. Per-model frame counts ride the stream
+        # condition like _frames_total.
+        self._model_frames: dict[str, int] = {}  # guarded_by: _streams_cond
+        self.zoo = zoo_lib.ModelZoo(default=self.model_label)
+        self.zoo.add(zoo_lib.ZooEntry(
+            name=self.model_label,
+            variant=variants_lib.VARIANTS[self.model_label],
+            analyze=None,  # the default model reads through self._engine
+            variables=None, version=version, precision=self.precision,
+        ))
+        self._build_zoo_entries(version)
 
     def _set_refuse_streams(self, refusing: bool) -> None:
         """Controller brownout rung 3 actuator."""
@@ -498,29 +547,36 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
     # -- drift observability ------------------------------------------------
 
     def _load_drift_profile(
-            self, version: int | None) -> profile_lib.FeatureProfile | None:
+            self, version: int | None, model_name: str | None = None,
+            allow_explicit: bool = True,
+    ) -> profile_lib.FeatureProfile | None:
         """Resolve the reference profile: an explicit path
         (cfg.drift_profile_path / RDP_DRIFT_PROFILE) wins, else the
         ``drift_profile.json`` artifact next to the served registry
-        version's weights; None means self-baseline."""
-        path = profile_lib.resolve_drift_profile_path(
-            self.cfg.drift_profile_path
-        )
-        if path is not None:
-            try:
-                return profile_lib.FeatureProfile.load(path)
-            except Exception as exc:
-                log.warning(
-                    "drift profile %s unusable (%s: %s); falling back to "
-                    "registry artifact / self-baseline",
-                    path, type(exc).__name__, exc,
-                )
+        version's weights; None means self-baseline. ``model_name``
+        selects the registry entry (default: the server's default
+        model); the explicit-path override only ever applies to the
+        default model -- one path cannot reference M distributions."""
+        model_name = model_name or self.cfg.model_name
+        if allow_explicit:
+            path = profile_lib.resolve_drift_profile_path(
+                self.cfg.drift_profile_path
+            )
+            if path is not None:
+                try:
+                    return profile_lib.FeatureProfile.load(path)
+                except Exception as exc:
+                    log.warning(
+                        "drift profile %s unusable (%s: %s); falling back "
+                        "to registry artifact / self-baseline",
+                        path, type(exc).__name__, exc,
+                    )
         if version is None:
             return None
         try:
             artifact = (
                 self._registry_store.version_path(
-                    self.cfg.model_name, version
+                    model_name, version
                 ) / profile_lib.DRIFT_PROFILE_FILE
             )
             if artifact.exists():
@@ -528,17 +584,43 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         except Exception as exc:
             log.warning(
                 "no drift profile artifact for %s v%s (%s: %s); "
-                "self-baselining", self.cfg.model_name, version,
+                "self-baselining", model_name, version,
                 type(exc).__name__, exc,
             )
         return None
 
     def _on_drift_score(self, signal: str,
                         score: profile_lib.DriftScore) -> None:
-        obs.DRIFT_SCORE.labels(signal=signal).set(score.psi)
+        obs.DRIFT_SCORE.labels(signal=signal,
+                               model=self.model_label).set(score.psi)
         if self.drift is not None:
             age = self.drift.reference_age_s
             obs.DRIFT_REFERENCE_AGE.set(-1.0 if age is None else age)
+
+    def _on_model_drift_score(self, model: str, signal: str,
+                              score: profile_lib.DriftScore) -> None:
+        """Per-zoo-model drift scoring hook (extras; the default model's
+        monitor keeps the legacy ``_on_drift_score`` path)."""
+        obs.DRIFT_SCORE.labels(signal=signal, model=model).set(score.psi)
+
+    def _on_model_drift_recommendation(
+            self, model: str,
+            rec: profile_lib.RetrainRecommendation) -> None:
+        """A non-default zoo model drifted: counted, pinned, logged. NOT
+        forwarded to the rollout manager -- the drain/retrain/shadow
+        cycle drives the default model's generation; extra zoo models
+        retrain through their own registry workflow (their promotion is
+        an alias move this server's reload poller does not watch yet)."""
+        obs.DRIFT_RECOMMENDATIONS.inc()
+        recorder_lib.RECORDER.pin(recorder_lib.RECORDER.record_event(
+            "serving.drift_recommendation", model=model,
+            signals=",".join(rec.signals),
+            generation=str(rec.generation),
+            reference=rec.reference_source,
+            reason=rec.reason,
+        ))
+        log.warning("DRIFT[%s]: %s -- recommend retraining", model,
+                    rec.reason)
 
     def _on_drift_recommendation(
             self, rec: profile_lib.RetrainRecommendation) -> None:
@@ -764,7 +846,21 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 ),
                 router=router,
                 admission=cfg.admission_policy,
+                placer=self.placer,
+                model_label=self.model_label,
             )
+            # a hot-reload builds a FRESH dispatcher for the new default
+            # generation; the zoo's extra models (whose generations did
+            # not move) re-bind onto it so their serving is uninterrupted
+            existing_zoo = getattr(self, "zoo", None)
+            if existing_zoo is not None:
+                for entry in existing_zoo.extras():
+                    if entry.batch_analyze is not None:
+                        dispatcher.bind_model(
+                            entry.name, entry.batch_analyze,
+                            entry.per_chip_analyzers,
+                            entry.sharded_analyzer,
+                        )
         return Engine(analyze, variables, dispatcher, version)
 
     @staticmethod
@@ -782,6 +878,212 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         log.info("serving with Pallas-fused U-Net forward")
         return lambda _variables, x: pnet(x)
 
+    # -- model zoo -----------------------------------------------------------
+
+    def _build_zoo_entries(self, default_version: int | None) -> None:
+        """Load and bind every non-default zoo model: its own registry
+        entry (alias-first, like the default), precision transform,
+        analyzers bound onto the SHARED dispatcher, per-model drift
+        monitor, and per-model SLO tracker. A model whose registry entry
+        is missing is skipped with a warning -- the server serves what
+        exists rather than refusing to boot (the zoo is additive)."""
+        cfg = self.cfg
+        self._model_slo: dict[str, slo_lib.SloTracker] = {}
+        if len(self._zoo_names) > 1:
+            slo_ms = slo_lib.resolve_slo_ms(cfg.slo_ms)
+            if slo_ms is not None:
+                # per-model burn for the default model too; the
+                # aggregate tracker (self.slo, model="") keeps feeding
+                # the controller and the fleet
+                self._model_slo[self.model_label] = slo_lib.SloTracker(
+                    slo_ms / 1e3, budget=cfg.slo_budget,
+                    window=cfg.slo_window,
+                    name=f"e2e/{self.model_label}",
+                    burn_gauge=obs.SLO_BURN.labels(
+                        objective="e2e", model=self.model_label),
+                )
+        for name in self._zoo_names[1:]:
+            variant = variants_lib.VARIANTS[name]
+            reg_name = variants_lib.registered_name(
+                variant, cfg.model_name)
+            try:
+                alias = self._registry_store.get_alias(
+                    reg_name, cfg.model_alias)
+                version = (int(alias) if alias is not None else int(
+                    self._registry_store.latest_version(
+                        reg_name)["version"]))
+                zmodel, zvariables = tracking.load_model(
+                    f"models:/{reg_name}/{version}",
+                    store=self._registry_store,
+                )
+            except Exception as exc:
+                log.warning(
+                    "zoo model %r (%s) unavailable (%s: %s); serving "
+                    "without it", name, reg_name,
+                    type(exc).__name__, exc,
+                )
+                continue
+            try:
+                entry = self._make_zoo_entry(name, variant, reg_name,
+                                             zmodel, zvariables, version)
+            except Exception:
+                log.exception("zoo model %r failed to build; serving "
+                              "without it", name)
+                continue
+            self.zoo.add(entry)
+            log.info("zoo model %r: %s v%s (%s tier, %s head)",
+                     name, reg_name, version, entry.precision,
+                     variant.head)
+
+    def _make_zoo_entry(self, name: str, variant, reg_name: str,
+                        model, variables,
+                        version: int | None) -> zoo_lib.ZooEntry:
+        """One non-default zoo entry: mirror of the default engine build
+        (precision transform, explicit weight staging, per-chip/sharded
+        router bindings) against this model's own weights."""
+        cfg, geom_cfg = self.cfg, self.geom_cfg
+        pristine = (model, variables)
+        model_q, variables_q, qreport = quant.apply_precision(
+            model, variables, self.precision
+        )
+        if qreport is not None and qreport.get("layers"):
+            log.info("int8-quantized %d conv kernels for zoo model %r",
+                     qreport["layers"], name)
+        if any(not isinstance(leaf, jax.Array)
+               for leaf in jax.tree_util.tree_leaves(variables_q)):
+            variables_q = jax.device_put(variables_q)
+        # zoo extras always run the Flax/XLA forward: the Pallas-fused
+        # net binds one model's weights at build time and has no
+        # multi-model dispatch (same policy as serving meshes)
+        analyze = pipeline.make_frame_analyzer(
+            model_q, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
+        )
+        batch_analyze = per_chip = sharded = None
+        dispatcher = self._engine.dispatcher
+        if dispatcher is not None:
+            make_batched = (pipeline.make_batch_analyzer
+                            if cfg.batch_impl == "dense"
+                            else pipeline.make_scan_batch_analyzer)
+            batched = make_batched(
+                model_q, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
+            )
+            batch_analyze = (
+                lambda frames, depths, intr, scales,
+                       _b=batched, _v=variables_q:
+                _b(_v, frames, depths, intr, scales)
+            )
+            if self._serving_mesh is not None:
+                from robotic_discovery_platform_tpu.parallel import (
+                    mesh as mesh_lib,
+                )
+
+                if self.dispatch_mode == "round_robin":
+                    # per-(model, chip) committed weight replicas, like
+                    # the default model's router bindings: an
+                    # uncommitted tree would re-transfer per dispatch
+                    per_chip = [
+                        (lambda frames, depths, intr, scales,
+                                _b=batched, _v=v:
+                         _b(_v, frames, depths, intr, scales))
+                        for v in (
+                            jax.device_put(variables_q, d)
+                            for d in mesh_lib.device_ring(
+                                self._serving_mesh)
+                        )
+                    ]
+                else:
+                    v_repl = mesh_lib.shard_pytree(
+                        self._serving_mesh, variables_q
+                    )
+                    sharded = (
+                        lambda frames, depths, intr, scales,
+                               _b=batched, _v=v_repl:
+                        _b(_v, frames, depths, intr, scales)
+                    )
+            dispatcher.bind_model(name, batch_analyze, per_chip, sharded)
+        drift = None
+        if cfg.drift_enabled:
+            reference = self._load_drift_profile(
+                version, model_name=reg_name, allow_explicit=False)
+            drift = profile_lib.DriftMonitor(
+                reference=reference,
+                window=cfg.drift_window,
+                baseline_frames=cfg.drift_baseline_frames,
+                score_every=cfg.drift_score_every,
+                psi_threshold=cfg.drift_psi_threshold,
+                sustain_s=cfg.drift_sustain_s,
+                cooldown_s=cfg.drift_cooldown_s,
+                generation=version,
+                on_score=functools.partial(
+                    self._on_model_drift_score, name),
+                on_recommendation=functools.partial(
+                    self._on_model_drift_recommendation, name),
+            )
+        slo_ms = slo_lib.resolve_slo_ms(cfg.slo_ms)
+        slo_tracker = None
+        if slo_ms is not None:
+            slo_tracker = slo_lib.SloTracker(
+                slo_ms / 1e3, budget=cfg.slo_budget,
+                window=cfg.slo_window, name=f"e2e/{name}",
+                burn_gauge=obs.SLO_BURN.labels(objective="e2e",
+                                               model=name),
+            )
+            self._model_slo[name] = slo_tracker
+        return zoo_lib.ZooEntry(
+            name=name, variant=variant, analyze=analyze,
+            variables=variables_q, version=version,
+            precision=self.precision, pristine=pristine, drift=drift,
+            slo=slo_tracker, batch_analyze=batch_analyze,
+            per_chip_analyzers=per_chip, sharded_analyzer=sharded,
+        )
+
+    def _resolve_model(self, name: str) -> tuple[str, Any]:
+        """Map one wire ``model`` field to (metric label, zoo entry).
+        "" and the default name both resolve to (default label, None) --
+        None meaning "use the legacy engine path", which is how the
+        default model stays byte-for-byte pre-zoo. Unknown names raise
+        :class:`zoo_lib.UnknownModelError` (a per-frame error)."""
+        if not name or name == self.model_label:
+            return self.model_label, None
+        entry = self.zoo.get(name)
+        if entry is None:
+            raise zoo_lib.UnknownModelError(
+                f"model {name!r} is not in this server's zoo "
+                f"({', '.join(self.zoo.names())})"
+            )
+        return name, entry
+
+    def zoo_debug(self) -> dict:
+        """The ``GET /debug/zoo`` payload: roster, per-model versions /
+        heads / frame counts, the placer's live placement + rate
+        correlations, and the (model, placement, bucket) warm set."""
+        with self._streams_cond:
+            frames = dict(self._model_frames)
+        models = {}
+        for n in self.zoo.names():
+            e = self.zoo.get(n)
+            models[n] = {
+                "version": (self._engine.version
+                            if n == self.model_label else e.version),
+                "head": e.variant.head,
+                "registered_name": variants_lib.registered_name(
+                    e.variant, self.cfg.model_name),
+                "precision": e.precision,
+                "frames": frames.get(n, 0),
+                "parity": e.parity if n != self.model_label else self.parity,
+            }
+        dispatcher = self._engine.dispatcher
+        return {
+            "enabled": len(self._zoo_names) > 1,
+            "default": self.model_label,
+            "models": models,
+            "placement": (self.placer.snapshot()
+                          if self.placer is not None else None),
+            "warmed": (sorted(
+                [list(map(str, k)) for k in dispatcher.warmed])
+                if dispatcher is not None else []),
+        }
+
     # -- per-frame ----------------------------------------------------------
 
     def _decode(self, request: vision_pb2.AnalysisRequest):
@@ -792,7 +1094,8 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
     def _analyze_frame(self, rgb: np.ndarray, depth: np.ndarray,
                        timer: StageTimer | None = None,
-                       timeout_s: float | None = None):
+                       timeout_s: float | None = None,
+                       model: str = ""):
         import cv2
 
         inject("serving.analyze")
@@ -804,18 +1107,23 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # is one dict hit now
         geom = self._geom_cache.lookup(self.intrinsics, w, h,
                                        self.depth_scale)
+        # zoo resolution: "" / the default name keep the legacy engine
+        # path verbatim (entry None); an unknown name is a per-frame
+        # error raised before any device work
+        _, entry = self._resolve_model(model)
         # ONE read of the engine per frame: analyze/variables/dispatcher
         # swap together, so a concurrent hot-reload cannot mix generations
         eng = self._engine
         with timer.stage("device"):
             if eng.dispatcher is not None:
-                # coalesce with co-arriving frames from other streams; the
-                # submit carries the caller's remaining deadline so a
-                # cancelled/expired client frees this thread instead of
-                # parking it on an unbounded wait
+                # coalesce with co-arriving frames of the SAME model from
+                # other streams; the submit carries the caller's
+                # remaining deadline so a cancelled/expired client frees
+                # this thread instead of parking it on an unbounded wait
                 out = eng.dispatcher.submit(
                     rgb, depth, geom.k_f32, self.depth_scale,
                     timeout_s=timeout_s,
+                    model=entry.name if entry is not None else "",
                 )
             else:
                 # explicit H2D for the frame inputs: the jitted entry runs
@@ -827,8 +1135,12 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 # per distinct content, not once per frame.
                 k_dev, scale_dev = geom.staged()
                 frames_dev = jax.device_put((rgb, depth))
-                out = eng.analyze(eng.variables, *frames_dev, k_dev,
-                                  scale_dev)
+                if entry is not None:
+                    out = entry.analyze(entry.variables, *frames_dev,
+                                        k_dev, scale_dev)
+                else:
+                    out = eng.analyze(eng.variables, *frames_dev, k_dev,
+                                      scale_dev)
             # host fetch of the fused result
             mask = np.asarray(out.mask)
             coverage = float(out.mask_coverage)
@@ -847,19 +1159,30 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             ok, mask_png = cv2.imencode(".png", mask * 255)
         if not ok:
             raise ValueError("mask encode failed")
+        anomaly = None
+        if entry is not None and entry.variant.head == "anomaly":
+            # the aux head's product: defect/anomaly score off the
+            # confidence margin the fused graph already computed
+            anomaly = variants_lib.anomaly_score(margin)
+            obs.MODEL_ANOMALY_SCORE.observe(anomaly)
         res = _FrameResult(mean_k, max_k, spline, mask_png.tobytes(),
-                           coverage, valid, margin, depth_valid)
-        self._mirror_shadow(rgb, depth, geom.k_f32, mask, res)
+                           coverage, valid, margin, depth_valid, anomaly)
+        if entry is None:
+            # only default-model frames mirror to a rollout shadow: the
+            # shadow diff gates the DEFAULT generation's replacement
+            self._mirror_shadow(rgb, depth, geom.k_f32, mask, res)
         return res
 
-    def _observe_drift(self, res: _FrameResult) -> None:
-        """Feed one analyzed frame's signals to the drift monitor and the
-        confidence-margin histogram -- pure host-side Python, after the
-        response is already built."""
+    def _observe_drift(self, res: _FrameResult,
+                       entry=None) -> None:
+        """Feed one analyzed frame's signals to its model's drift
+        monitor and the confidence-margin histogram -- pure host-side
+        Python, after the response is already built."""
         obs.MODEL_CONFIDENCE_MARGIN.observe(res.confidence_margin)
-        if self.drift is None:
+        monitor = self.drift if entry is None else entry.drift
+        if monitor is None:
             return
-        self.drift.observe_frame({
+        monitor.observe_frame({
             "mask_coverage": res.coverage,
             "mean_curvature": res.mean_k if res.valid else math.nan,
             "max_curvature": res.max_k if res.valid else math.nan,
@@ -965,9 +1288,23 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # (read under the reload lock): a scrape racing a promotion sees
         # either the old pair or the new pair, never a mix
         version, drift_generation = self.version_and_reference()
+        with self._streams_cond:
+            model_frames = dict(self._model_frames)
+        # per-model demand next to the aggregate: the capacity planner's
+        # per-model rate inputs (ROADMAP) and the fleet dashboard's
+        # multi-tenant view ride this block
+        rates = self.placer.rates() if self.placer is not None else {}
+        models = {
+            name: {
+                "frames": model_frames.get(name, 0),
+                "rate": round(rates.get(name, 0.0), 3),
+            }
+            for name in self.zoo.names()
+        }
         return {
             "inflight_streams": self.active_streams,
             "frames_total": self._frames_total,
+            "models": models,
             "burn": self.slo.burn if self.slo is not None else 0.0,
             "slo_ms": self.cfg.slo_ms,
             "chips": self.serving_chips,
@@ -1035,14 +1372,26 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             for inf in frames:
                 remaining = inf.time_remaining
                 t0 = time.perf_counter()
+                label = self.model_label
+                entry = None
                 try:
                     # handler-side decode cost (inline: the decode itself;
                     # pooled: the wait, ~0 once read-ahead is primed)
                     timer.observe("decode", inf.wait_s)
                     if inf.error is not None:
                         raise inf.error
+                    label, entry = self._resolve_model(inf.model)
                     res = self._analyze_frame(inf.rgb, inf.depth, timer,
-                                              timeout_s=remaining)
+                                              timeout_s=remaining,
+                                              model=inf.model)
+                    status = ("OK" if res.valid
+                              else "DEGRADED: insufficient geometry")
+                    if res.anomaly is not None:
+                        # the aux head's verdict rides the status text:
+                        # wire-compatible (clients key on OK/DEGRADED/
+                        # ERROR prefixes), and only ever present on
+                        # frames that explicitly asked for this model
+                        status += f" anomaly={res.anomaly:.4f}"
                     response = vision_pb2.AnalysisResponse(
                         mean_curvature=res.mean_k,
                         max_curvature=res.max_k,
@@ -1050,14 +1399,23 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                             vision_pb2.Point3D(x=float(p[0]), y=float(p[1]), z=float(p[2]))
                             for p in res.spline
                         ],
-                        status="OK" if res.valid
-                               else "DEGRADED: insufficient geometry",
+                        status=status,
                         mask=res.mask_png,
                         mask_coverage=res.coverage,
                     )
                     self.metrics.append(res.mean_k, res.max_k, res.coverage)
-                    self._observe_drift(res)
+                    self._observe_drift(res, entry)
                     status_label = "ok" if res.valid else "degraded"
+                except zoo_lib.UnknownModelError as exc:
+                    # a typo'd model name is a bad frame, not a dead
+                    # stream: per-frame error, bounded metric
+                    # cardinality (requested names never become labels)
+                    label = "unknown"
+                    response = vision_pb2.AnalysisResponse(
+                        status=f"ERROR: UnknownModel: {exc} "
+                               f"[trace={trace.current_trace_id() or '-'}]"
+                    )
+                    status_label = "error"
                 except OverloadedError as exc:
                     # load shedding is a STREAM-level, retryable condition:
                     # surface the standard backpressure status instead of a
@@ -1065,9 +1423,12 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     # from a bad frame. The trace ID rides the details so
                     # the client-side failure joins its /debug/spans
                     # timeline; a shed frame also burned SLO budget.
-                    obs.FRAMES.labels(status="shed").inc()
+                    obs.FRAMES.labels(status="shed", model=label).inc()
                     if self.slo is not None:
                         self.slo.observe(float("inf"), ok=False)
+                    mslo = self._model_slo.get(label)
+                    if mslo is not None:
+                        mslo.observe(float("inf"), ok=False)
                     context.abort(
                         grpc.StatusCode.RESOURCE_EXHAUSTED,
                         f"{exc} [trace={trace.current_trace_id() or '-'}]",
@@ -1102,15 +1463,22 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 response.proc_time_ms = total_s * 1e3
                 with self._streams_cond:
                     self._frames_total += 1
-                obs.FRAMES.labels(status=status_label).inc()
+                    self._model_frames[label] = (
+                        self._model_frames.get(label, 0) + 1)
+                obs.FRAMES.labels(status=status_label, model=label).inc()
                 obs.STAGE_LATENCY.labels(stage="total").observe(total_s)
                 obs.STAGE_LATENCY_SUMMARY.labels(stage="total").observe(
                     total_s)
                 obs.FRAME_LATENCY_SUMMARY.observe(total_s)
+                frame_ok = status_label in ("ok", "degraded")
                 if self.slo is not None:
-                    self.slo.observe(
-                        total_s, ok=status_label in ("ok", "degraded")
-                    )
+                    self.slo.observe(total_s, ok=frame_ok)
+                mslo = self._model_slo.get(label)
+                if mslo is not None:
+                    # per-model burn next to the aggregate: which tenant
+                    # is burning its budget is the question multi-model
+                    # dashboards (and the capacity planner) ask
+                    mslo.observe(total_s, ok=frame_ok)
                 yield response
             self.metrics.flush()
             if timer.totals:
@@ -1324,9 +1692,16 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         with self._reload_lock:
             self._warm_engine(self._engine)
         self._analyze_frame(color, depth)
+        # CAPPED zoo warm (lazy elsewhere): each extra model pre-compiles
+        # zoo_eager_warm home placements for the single-frame bucket;
+        # every other (model, chip, bucket) combo compiles on its first
+        # dispatch -- an M-model zoo must not multiply startup by
+        # M x chips x buckets
+        self._warm_zoo(width, height)
         # bf16/int8 tiers must PROVE parity against the f32 goldens before
         # readiness ever flips -- a quantized engine that fails its gate
-        # never serves a frame
+        # never serves a frame (per zoo model: each entry gates against
+        # its OWN pristine f32 pair)
         self._parity_gate(width, height)
         # readiness flips ONLY here: a probe sees SERVING once the first
         # real frame path has compiled and run, never before
@@ -1334,17 +1709,85 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         log.info("warmed up %dx%d analyzer on %s", width, height,
                  jax.default_backend())
 
+    def _warm_zoo(self, width: int, height: int) -> None:
+        """Capped eager warm for the non-default zoo entries."""
+        if len(self.zoo) <= 1:
+            return
+        color, depth = _warm_frames(width, height)
+        k = np.asarray(
+            self.intrinsics if self.intrinsics is not None
+            else _default_intrinsics(width, height), np.float32,
+        )
+        dispatcher = self._engine.dispatcher
+        full = self.cfg.zoo_eager_warm < 0
+        for entry in self.zoo.extras():
+            if dispatcher is None:
+                entry.analyze(
+                    entry.variables, color, depth, k,
+                    np.float32(self.depth_scale),
+                )
+                continue
+            if full:
+                # zoo_eager_warm < 0: the pre-zoo full eager warm per
+                # model -- every reachable bucket on every placement
+                # (benchmarks measuring steady-state multiplexing, and
+                # deployments that prefer slow boots over first-burst
+                # compile stalls)
+                sizes, b = set(), 1
+                while b < self.cfg.max_batch:
+                    sizes.add(dispatcher.bucket_for(b))
+                    b *= 2
+                sizes.add(dispatcher.bucket_for(self.cfg.max_batch))
+            else:
+                sizes = {dispatcher.bucket_for(1)}
+            home: list[int] | None = None
+            if (not full and self.placer is not None
+                    and self.serving_chips > 1):
+                cap = max(1, int(self.cfg.zoo_eager_warm))
+                home = list(self.placer.chips_for(entry.name)[:cap])
+            for b in sorted(sizes):
+                dispatcher.warm(
+                    np.repeat(color[None], b, 0),
+                    np.repeat(depth[None], b, 0),
+                    np.repeat(k[None], b, 0),
+                    np.full((b,), self.depth_scale, np.float32),
+                    model=entry.name, chips=home,
+                )
+
     def _parity_gate(self, width: int, height: int) -> dict | None:
         """Warm-up parity check for the reduced-precision tiers: run the
         golden synthetic frames through BOTH an f32 reference analyzer
-        (built from the current generation's pristine variables) and the
-        live engine path (dispatcher when batching, single-frame analyze
-        otherwise), publish the rdp_quant_parity_* gauges, and refuse to
-        come up when the thresholds are breached. No-op at f32."""
+        (built from each generation's pristine variables) and the live
+        engine path (dispatcher when batching, single-frame analyze
+        otherwise), publish the rdp_quant_parity_* gauges per zoo model,
+        and refuse to come up when the thresholds are breached. No-op at
+        f32. Every zoo entry gates against its OWN goldens -- one
+        model's quantization error can never hide behind another's."""
         if self.precision == "f32":
             return None
+        eng = self._engine
+        report = self._parity_gate_for(
+            self.model_label, self._pristine,
+            got_path=(None if eng.dispatcher is not None else
+                      (eng.analyze, eng.variables)),
+            submit_model="", width=width, height=height,
+        )
+        self.parity = report
+        for entry in self.zoo.extras():
+            entry.parity = self._parity_gate_for(
+                entry.name, entry.pristine,
+                got_path=(None if eng.dispatcher is not None else
+                          (entry.analyze, entry.variables)),
+                submit_model=entry.name, width=width, height=height,
+            )
+        return report
+
+    def _parity_gate_for(self, name: str, pristine, got_path,
+                         submit_model: str, width: int,
+                         height: int) -> dict:
+        """One model's golden-frame parity gate (fail-closed)."""
         cfg = self.cfg
-        ref_model, ref_variables = self._pristine
+        ref_model, ref_variables = pristine
         ref_analyze = pipeline.make_frame_analyzer(
             ref_model, img_size=cfg.model_img_size, geom_cfg=self.geom_cfg
         )
@@ -1359,34 +1802,35 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             cfg.quant_parity_frames, height, width
         ):
             refs.append(ref_analyze(ref_variables, rgb, depth, k, scale))
-            if eng.dispatcher is not None:
-                gots.append(eng.dispatcher.submit(rgb, depth, k,
-                                                  float(scale)))
+            if got_path is None:
+                gots.append(eng.dispatcher.submit(
+                    rgb, depth, k, float(scale), model=submit_model))
             else:
-                gots.append(eng.analyze(eng.variables, rgb, depth, k,
-                                        scale))
+                analyze, variables = got_path
+                gots.append(analyze(variables, rgb, depth, k, scale))
         report = quant.parity_report(refs, gots)
-        self.parity = report
-        obs.QUANT_PARITY_IOU.set(report["mask_iou_mean"])
-        obs.QUANT_PARITY_CURV.labels(stat="mean").set(
+        obs.QUANT_PARITY_IOU.labels(model=name).set(
+            report["mask_iou_mean"])
+        obs.QUANT_PARITY_CURV.labels(stat="mean", model=name).set(
             report["curvature_err_mean"])
-        obs.QUANT_PARITY_CURV.labels(stat="max").set(
+        obs.QUANT_PARITY_CURV.labels(stat="max", model=name).set(
             report["curvature_err_max"])
         if not quant.parity_gates_pass(
             report, cfg.quant_parity_min_iou, cfg.quant_parity_max_curv_err
         ):
             raise RuntimeError(
-                f"{self.precision} serving failed its parity gate vs the "
-                f"f32 goldens: mean IoU {report['mask_iou_mean']:.4f} "
+                f"{self.precision} serving of model {name!r} failed its "
+                f"parity gate vs the f32 goldens: mean IoU "
+                f"{report['mask_iou_mean']:.4f} "
                 f"(floor {cfg.quant_parity_min_iou}), max |d curvature| "
                 f"{report['curvature_err_max']:.4f} (ceiling "
                 f"{cfg.quant_parity_max_curv_err}) over "
                 f"{report['frames']} frames"
             )
         log.info(
-            "%s parity gate passed: mean IoU %.4f, curvature err "
+            "%s parity gate passed for %s: mean IoU %.4f, curvature err "
             "mean %.4g / max %.4g over %d goldens",
-            self.precision, report["mask_iou_mean"],
+            self.precision, name, report["mask_iou_mean"],
             report["curvature_err_mean"], report["curvature_err_max"],
             report["frames"],
         )
@@ -1500,6 +1944,9 @@ def build_server(
         # /debug/drift serves the monitor's live state (histograms,
         # scores, recommendation ladder) next to /debug/spans
         servicer.metrics_server.set_drift_provider(servicer.drift_debug)
+        # /debug/zoo: roster, per-model versions/frames, live placement
+        # + rate correlations, and the (model, placement, bucket) warm set
+        servicer.metrics_server.set_zoo_provider(servicer.zoo_debug)
         # /debug/rollout resolves the manager per request, so attaching
         # one after boot (rollout_lib.attach_rollout) makes the endpoint
         # live without re-wiring

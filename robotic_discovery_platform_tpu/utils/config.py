@@ -408,6 +408,47 @@ class ServerConfig:
     # idle placement weight (0 would starve its burn signal, the same
     # reason brownout rung 3 duty-cycles instead of refusing all).
     fleet_weight_floor: float = 0.1
+    # -- model zoo + statistical multiplexing (serving/zoo.py) --------------
+    # Comma-separated zoo roster from the models/variants.py catalog
+    # ("seg,multi,aux"): the named engine generations this server holds
+    # side by side, each with its own registry entry, precision tier,
+    # parity gate, drift reference, and SLO tracker, statistically
+    # multiplexed over the shared chip mesh. "" (default) = the legacy
+    # single-model server -- the empty roster resolves to the seed
+    # binary segmenter alone and the serving path stays bitwise
+    # identical to pre-zoo. A wire request's ``model`` field picks the
+    # entry per frame ("" = default). The RDP_ZOO_MODELS env var
+    # overrides this value.
+    zoo_models: str = ""
+    # How models map onto chips: "shared" (default) lets the ZooPlacer
+    # co-locate models whose measured arrival-rate peaks anti-correlate
+    # (AlpaServe-style statistical multiplexing -- each model's burst
+    # capacity is every chip its quiet neighbors are not using);
+    # "dedicated" pins the static contiguous partition (silicon per
+    # model -- the comparison baseline bench_load.py --models measures
+    # the multiplexing win against). The RDP_ZOO_PLACEMENT env var
+    # overrides this value.
+    zoo_placement: str = "shared"
+    # ZooPlacer rate-window geometry: per-model arrivals are counted
+    # into zoo_rate_interval_s buckets over a zoo_rate_window-bucket
+    # sliding window; correlations and placements recompute from it.
+    zoo_rate_interval_s: float = 1.0
+    zoo_rate_window: int = 60
+    # How often a recorded arrival may trigger a re-placement.
+    zoo_rebalance_s: float = 5.0
+    # Co-location cap: a model extends onto a chip only when every
+    # resident's rate correlation with it is below this (unknown /
+    # anti-correlated models share freely; synchronized peaks separate).
+    zoo_corr_cap: float = 0.25
+    # Capped eager warm-up for EXTRA zoo models: how many placements
+    # each non-default model pre-compiles (single-frame bucket) at
+    # warmup(); the default model keeps its full eager warm. Everything
+    # else compiles lazily on its first dispatch -- eagerly warming
+    # M x chips x buckets would explode startup. Negative = FULL eager
+    # warm per model (every bucket on every placement): slow boot, zero
+    # first-burst compile stalls -- what the multimodel bench legs use
+    # to measure steady-state multiplexing.
+    zoo_eager_warm: int = 1
     # -- chip quarantine (serving/batching.DeviceRouter) --------------------
     # Per-chip dispatch circuit breaker: after this many consecutive
     # dispatch failures on one mesh chip, that chip is quarantined

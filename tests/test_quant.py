@@ -207,10 +207,13 @@ def test_server_warmup_parity_gate_passes(model_and_vars, tmp_path):
         assert svc.parity["mask_iou_mean"] >= 0.9
         assert obs.SERVING_PRECISION.labels(precision="int8").value == 1.0
         assert obs.SERVING_PRECISION.labels(precision="f32").value == 0.0
-        assert obs.QUANT_PARITY_IOU.value == pytest.approx(
-            svc.parity["mask_iou_mean"]
+        # the parity gauges are per zoo model now; a single-model
+        # server's child carries its default catalog name ("seg")
+        assert obs.QUANT_PARITY_IOU.labels(model="seg").value == (
+            pytest.approx(svc.parity["mask_iou_mean"])
         )
-        assert obs.QUANT_PARITY_CURV.labels(stat="max").value == (
+        assert obs.QUANT_PARITY_CURV.labels(stat="max",
+                                            model="seg").value == (
             pytest.approx(svc.parity["curvature_err_max"])
         )
     finally:

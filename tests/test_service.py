@@ -927,7 +927,9 @@ def test_debug_spans_quantiles_and_slo_live_server(registered_model,
         assert ladder == sorted(ladder)
         assert 'rdp_slo_objective_seconds{objective="e2e"} 30\n' in text
         assert 'rdp_slo_violations_total{objective="e2e"}' in text
-        assert 'rdp_slo_error_budget_burn{objective="e2e"}' in text
+        # the burn family carries a model label now (model="" = the
+        # all-models aggregate the controller and fleet consume)
+        assert 'rdp_slo_error_budget_burn{objective="e2e",model=""}' in text
     finally:
         server.stop(grace=None)
         servicer.close()

@@ -1,0 +1,450 @@
+"""Model zoo + statistical multiplexing (serving/zoo.py, models/variants.py).
+
+Covers the three acceptance surfaces of the zoo PR:
+
+- **default-path bitwise parity**: a zoo-enabled server with only the
+  seed segmenter registered answers byte-identically to the legacy
+  single-model server on the same stream (serial depth-1, f32,
+  workers=0) -- the zoo machinery must cost the default path nothing;
+- **per-model fault isolation**: one model's dispatch fault
+  (``serving.model.<name>.dispatch``) error-completes ONLY that model's
+  frames -- dispatch groups are single-model by construction;
+- **placement units**: the ZooPlacer co-locates anti-correlated models
+  on shared chips and confines positively-correlated ones; "dedicated"
+  pins the static partition; the keyed ServiceTimeEstimator never lets
+  one model's rides poison another's admission estimate.
+"""
+
+from __future__ import annotations
+
+import grpc
+import numpy as np
+import pytest
+
+from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+from robotic_discovery_platform_tpu.models import variants as variants_lib
+from robotic_discovery_platform_tpu.resilience import configure_faults
+from robotic_discovery_platform_tpu.serving import (
+    client as client_lib,
+    replica as replica_lib,
+    server as server_lib,
+    zoo as zoo_lib,
+)
+from robotic_discovery_platform_tpu.serving.admission import (
+    ServiceTimeEstimator,
+)
+from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
+from robotic_discovery_platform_tpu.utils.config import ServerConfig
+
+
+# -- catalog / config units --------------------------------------------------
+
+
+def test_resolve_zoo_models_default_and_order(monkeypatch):
+    monkeypatch.delenv("RDP_ZOO_MODELS", raising=False)
+    assert variants_lib.resolve_zoo_models("") == ("seg",)
+    # the default model is pinned first whatever the spec order says
+    assert variants_lib.resolve_zoo_models("aux,seg") == ("seg", "aux")
+    assert variants_lib.resolve_zoo_models("multi, aux") == (
+        "seg", "multi", "aux")
+    with pytest.raises(ValueError, match="unknown zoo model"):
+        variants_lib.resolve_zoo_models("seg,bogus")
+    monkeypatch.setenv("RDP_ZOO_MODELS", "seg,aux")
+    assert variants_lib.resolve_zoo_models("") == ("seg", "aux")
+    # the env override wins over any configured roster
+    assert variants_lib.resolve_zoo_models("multi") == ("seg", "aux")
+
+
+def test_variant_model_config_scales_width():
+    from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+    base = ModelConfig(base_features=64)
+    aux = variants_lib.VARIANTS["aux"].model_config(base)
+    assert aux.base_features == 16  # quarter width: the cheap ride-along
+    assert aux.num_classes == 1
+    multi = variants_lib.VARIANTS["multi"].model_config(base)
+    assert multi.num_classes == 4
+    assert multi.base_features == 64
+    seg = variants_lib.VARIANTS["seg"].model_config(base)
+    assert seg == base  # the default variant is the seed config verbatim
+
+
+def test_anomaly_score_flips_margin():
+    assert variants_lib.anomaly_score(0.5) == 0.0  # saturated confidence
+    assert variants_lib.anomaly_score(0.0) == 1.0  # maximal uncertainty
+    assert variants_lib.anomaly_score(0.25) == pytest.approx(0.5)
+    # out-of-range margins clamp instead of going negative
+    assert variants_lib.anomaly_score(0.7) == 0.0
+    assert variants_lib.anomaly_score(-1.0) == 1.0
+
+
+def test_resolve_zoo_placement(monkeypatch):
+    monkeypatch.delenv("RDP_ZOO_PLACEMENT", raising=False)
+    assert zoo_lib.resolve_zoo_placement("shared") == "shared"
+    with pytest.raises(ValueError, match="unknown zoo placement"):
+        zoo_lib.resolve_zoo_placement("bogus")
+    monkeypatch.setenv("RDP_ZOO_PLACEMENT", "dedicated")
+    assert zoo_lib.resolve_zoo_placement("shared") == "dedicated"
+
+
+# -- wire protocol -----------------------------------------------------------
+
+
+def test_model_field_wire_compat():
+    """Empty ``model`` serializes to ZERO bytes (legacy requests are
+    bitwise identical on the wire) and legacy bytes parse with
+    ``model == ""``."""
+    img = vision_pb2.Image(data=b"x", width=1, height=1)
+    legacy = vision_pb2.AnalysisRequest(color_image=img).SerializeToString()
+    explicit_empty = vision_pb2.AnalysisRequest(
+        color_image=img, model="").SerializeToString()
+    assert explicit_empty == legacy
+    parsed = vision_pb2.AnalysisRequest()
+    parsed.ParseFromString(legacy)
+    assert parsed.model == ""
+    named = vision_pb2.AnalysisRequest(model="aux")
+    rt = vision_pb2.AnalysisRequest()
+    rt.ParseFromString(named.SerializeToString())
+    assert rt.model == "aux"
+
+
+def test_encode_request_carries_model():
+    color = np.zeros((8, 8, 3), np.uint8)
+    depth = np.zeros((8, 8), np.uint16)
+    assert client_lib.encode_request(color, depth).model == ""
+    assert client_lib.encode_request(color, depth, model="aux").model == "aux"
+    assert client_lib.encode_request(color, depth, fmt="raw",
+                                     model="multi").model == "multi"
+
+
+# -- keyed service-time estimator (satellite fix) ----------------------------
+
+
+def test_estimator_keys_isolate_models():
+    est = ServiceTimeEstimator(window=8)
+    est.observe(0.5, key=("seg", 4))
+    est.observe(0.4, key=("seg", 1))
+    est.observe(0.001, key=("aux", 1))  # the cheap ride-along
+    # per-model best case: the aux head's sub-ms rides never drive the
+    # segmenter's estimate down (the pre-zoo poisoning bug)
+    assert est.s_for("seg") == pytest.approx(0.4)
+    assert est.s_for("aux") == pytest.approx(0.001)
+    # a model with no history sheds nothing (0 = no earned guess)
+    assert est.s_for("multi") == 0.0
+    # the legacy global property is the min over everything
+    assert est.s == pytest.approx(0.001)
+    assert est.observations == 3
+
+
+def test_estimator_unkeyed_legacy_path():
+    est = ServiceTimeEstimator(window=4)
+    for v in (0.3, 0.2, 0.25):
+        est.observe(v)
+    assert est.s == pytest.approx(0.2)
+    assert est.s_for("") == pytest.approx(0.2)
+    est.observe(-1.0)  # ignored
+    assert est.observations == 3
+
+
+def test_estimator_window_slides_per_key():
+    est = ServiceTimeEstimator(window=2)
+    est.observe(0.1, key=("seg", 1))
+    est.observe(0.5, key=("seg", 1))
+    est.observe(0.6, key=("seg", 1))  # 0.1 slides out of the window
+    assert est.s_for("seg") == pytest.approx(0.5)
+
+
+# -- ZooPlacer units ---------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _drive_rates(placer, clock, pattern, seconds=40):
+    """Advance the fake clock one interval at a time, recording
+    ``pattern[model](t)`` arrivals per interval."""
+    for _ in range(seconds):
+        for model, rate_fn in pattern.items():
+            for _ in range(int(rate_fn(clock.t))):
+                placer.record_arrival(model)
+        clock.t += 1.0
+
+
+def test_rate_window_counts_per_interval():
+    clock = FakeClock()
+    win = zoo_lib.RateWindow(interval_s=1.0, window=10, clock=clock)
+    for _ in range(30):
+        win.record()
+        clock.t += 0.2  # 5 arrivals per 1s interval
+    assert win.mean_rate() == pytest.approx(5.0, rel=0.25)
+    # long idle gap zeroes the window instead of spinning the advance
+    clock.t += 1000.0
+    assert win.mean_rate() == 0.0
+
+
+def test_placer_anticorrelated_models_share_every_chip():
+    clock = FakeClock()
+    placer = zoo_lib.ZooPlacer(("seg", "aux"), chips=4, mode="shared",
+                               rebalance_s=0.0, clock=clock)
+    # square-wave bursts in perfect anti-phase: seg peaks while aux
+    # sleeps and vice versa -- the AlpaServe co-location case
+    _drive_rates(placer, clock, {
+        "seg": lambda t: 20 if (t // 10) % 2 == 0 else 1,
+        "aux": lambda t: 1 if (t // 10) % 2 == 0 else 20,
+    })
+    corr = placer.correlations()[("seg", "aux")]
+    assert corr < -0.5
+    placement = placer.rebalance()
+    assert placement["seg"] == (0, 1, 2, 3)
+    assert placement["aux"] == (0, 1, 2, 3)
+
+
+def test_placer_positively_correlated_models_are_confined():
+    clock = FakeClock()
+    placer = zoo_lib.ZooPlacer(("seg", "aux"), chips=4, mode="shared",
+                               rebalance_s=0.0, clock=clock)
+    # synchronized peaks: multiplexing buys nothing, so the lower-
+    # priority model is confined to its demand share instead of
+    # doubling up on every chip
+    _drive_rates(placer, clock, {
+        "seg": lambda t: 20 if (t // 10) % 2 == 0 else 1,
+        "aux": lambda t: 20 if (t // 10) % 2 == 0 else 1,
+    })
+    assert placer.correlations()[("seg", "aux")] > 0.5
+    placement = placer.rebalance()
+    confined = [m for m, chips in placement.items() if len(chips) < 4]
+    assert confined, f"expected confinement, got {placement}"
+    for m in confined:
+        assert len(placement[m]) == 2  # the demand-proportional share
+
+
+def test_placer_dedicated_partition_is_static():
+    placer = zoo_lib.ZooPlacer(("seg", "aux"), chips=4, mode="dedicated",
+                               clock=FakeClock())
+    assert placer.chips_for("seg") == (0, 1)
+    assert placer.chips_for("aux") == (2, 3)
+    # arrivals never move a dedicated partition
+    for _ in range(100):
+        placer.record_arrival("seg")
+    assert placer.chips_for("seg") == (0, 1)
+    assert placer.rebalances == 0
+
+
+def test_placer_unknown_model_gets_every_chip():
+    placer = zoo_lib.ZooPlacer(("seg",), chips=4, clock=FakeClock())
+    assert placer.chips_for("never-heard-of-it") == (0, 1, 2, 3)
+
+
+def test_placer_snapshot_shape():
+    placer = zoo_lib.ZooPlacer(("seg", "aux"), chips=2, clock=FakeClock())
+    snap = placer.snapshot()
+    assert snap["mode"] == "shared"
+    assert set(snap["placement"]) == {"seg", "aux"}
+    assert "seg/aux" in snap["correlation"]
+
+
+# -- live servers ------------------------------------------------------------
+
+
+FRAME_W, FRAME_H = 160, 120
+
+
+def _boot(uri, tmp_path, name, **overrides):
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        metrics_csv=str(tmp_path / f"{name}.csv"),
+        metrics_flush_every=1000,
+        calibration_path=str(tmp_path / "missing.npz"),
+        reload_poll_s=0.0,
+        **overrides,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    return server, servicer, f"localhost:{port}"
+
+
+def _frames(n=4, seed=11):
+    source = SyntheticSource(width=FRAME_W, height=FRAME_H, seed=seed,
+                             n_frames=n)
+    source.start()
+    out = []
+    for _ in range(n):
+        out.append(source.get_frames())
+    source.stop()
+    return out
+
+
+def _stream(endpoint, requests, timeout=60):
+    stub = vision_grpc.VisionAnalysisServiceStub(
+        grpc.insecure_channel(endpoint))
+    return list(stub.AnalyzeActuatorPerformance(iter(requests),
+                                                timeout=timeout))
+
+
+def test_zoo_default_path_bitwise_parity(tmp_path):
+    """Acceptance: a zoo server with ONLY the seed segmenter registered
+    (the aux roster entry is missing from the registry and skipped)
+    answers byte-identically to the legacy single-model server on the
+    same stream -- serial depth-1 dispatch, f32, inline decode."""
+    uri = replica_lib.register_tiny_model(tmp_path / "mlruns",
+                                          models=("seg",))
+    serial = dict(batch_window_ms=2.0, max_batch=4,
+                  max_inflight_dispatches=1)
+    l_server, l_servicer, l_ep = _boot(uri, tmp_path, "legacy", **serial)
+    z_server, z_servicer, z_ep = _boot(uri, tmp_path, "zoo",
+                                       zoo_models="seg,aux", **serial)
+    try:
+        # the zoo server came up multi-tenant-shaped but single-model:
+        # aux was skipped (not registered), the placer exists
+        assert z_servicer.zoo.names() == ("seg",)
+        assert z_servicer.placer is not None
+        l_servicer.warmup(FRAME_W, FRAME_H)
+        z_servicer.warmup(FRAME_W, FRAME_H)
+        frames = _frames()
+        reqs = [client_lib.encode_request(c, d) for c, d in frames]
+        legacy = _stream(l_ep, reqs)
+        zoo = _stream(z_ep, reqs)
+        assert len(legacy) == len(zoo) == len(frames)
+        for a, b in zip(legacy, zoo):
+            assert a.status == b.status
+            assert a.status.startswith(("OK", "DEGRADED"))
+            assert "anomaly" not in a.status and "anomaly" not in b.status
+            assert a.mean_curvature == b.mean_curvature
+            assert a.max_curvature == b.max_curvature
+            assert a.mask_coverage == b.mask_coverage
+            assert a.mask == b.mask  # the whole mask PNG, bytewise
+            assert len(a.spline_points) == len(b.spline_points)
+            for p, q in zip(a.spline_points, b.spline_points):
+                assert (p.x, p.y, p.z) == (q.x, q.y, q.z)
+    finally:
+        for s, sv in ((l_server, l_servicer), (z_server, z_servicer)):
+            s.stop(grace=None)
+            sv.close()
+
+
+@pytest.fixture(scope="module")
+def zoo_server(tmp_path_factory):
+    """One seg+aux zoo server (micro-batching on, serial window) shared
+    by the multi-model tests below."""
+    tmp = tmp_path_factory.mktemp("zoo")
+    uri = replica_lib.register_tiny_model(tmp / "mlruns",
+                                          models=("seg", "aux"))
+    server, servicer, ep = _boot(uri, tmp, "zoo",
+                                 zoo_models="seg,aux",
+                                 batch_window_ms=2.0, max_batch=4,
+                                 slo_ms=30000.0)
+    servicer.warmup(FRAME_W, FRAME_H)
+    yield server, servicer, ep
+    server.stop(grace=None)
+    servicer.close()
+
+
+def test_multimodel_serving_end_to_end(zoo_server):
+    _, servicer, ep = zoo_server
+    assert servicer.zoo.names() == ("seg", "aux")
+    frames = _frames(3)
+    # default + explicit-default + aux + unknown, all on live streams
+    default = _stream(ep, [client_lib.encode_request(c, d)
+                           for c, d in frames])
+    named = _stream(ep, [client_lib.encode_request(c, d, model="seg")
+                         for c, d in frames])
+    aux = _stream(ep, [client_lib.encode_request(c, d, model="aux")
+                       for c, d in frames])
+    bogus = _stream(ep, [client_lib.encode_request(*frames[0],
+                                                   model="nope")])
+    for r in default + named:
+        assert r.status.startswith(("OK", "DEGRADED"))
+        assert "anomaly" not in r.status
+    # "" and the default's catalog name are the same model: identical
+    # bytes on the same input stream
+    for a, b in zip(default, named):
+        assert a.mask == b.mask and a.mean_curvature == b.mean_curvature
+    for r in aux:
+        assert r.status.startswith(("OK", "DEGRADED"))
+        assert "anomaly=" in r.status
+        score = float(r.status.rsplit("anomaly=", 1)[1])
+        assert 0.0 <= score <= 1.0
+    assert bogus[0].status.startswith("ERROR: UnknownModel")
+    # the stream survived the unknown model: a second frame still works
+    ok_after = _stream(ep, [client_lib.encode_request(*frames[0])])
+    assert ok_after[0].status.startswith(("OK", "DEGRADED"))
+    # per-model accounting reached the stats surface
+    stats = servicer.replica_stats()
+    assert stats["models"]["seg"]["frames"] >= 7
+    assert stats["models"]["aux"]["frames"] >= 3
+    # per-(model, bucket) service estimates are independent keys
+    est = servicer.dispatcher.service_estimate
+    assert est.s_for("") > 0.0
+    assert est.s_for("aux") > 0.0
+    assert est.s_for("multi") == 0.0
+    # /debug/zoo payload shape
+    debug = servicer.zoo_debug()
+    assert debug["enabled"] is True
+    assert debug["models"]["aux"]["head"] == "anomaly"
+    assert debug["placement"]["mode"] == "shared"
+
+
+def test_capped_zoo_warmup(zoo_server):
+    """The default model eagerly warms every reachable bucket; extras
+    warm exactly their capped home placement (the rest is lazy)."""
+    _, servicer, _ = zoo_server
+    warmed = servicer.dispatcher.warmed
+    # default model: buckets 1..max_batch warmed eagerly at warmup()
+    assert ("", 0, 1) in warmed
+    assert ("", 0, 4) in warmed
+    # aux: the single-frame bucket on its home placement only
+    assert ("aux", 0, 1) in warmed
+    assert ("aux", 0, 4) not in warmed  # lazy until a real burst needs it
+
+
+def test_model_fault_isolation(zoo_server):
+    """Acceptance: one model's chip-dispatch fault error-completes ONLY
+    that model's frames -- the other model's stream never sees an
+    error."""
+    _, servicer, ep = zoo_server
+    frames = _frames(4)
+    configure_faults("serving.model.aux.dispatch:exc:-1")
+    try:
+        seg = _stream(ep, [client_lib.encode_request(c, d)
+                           for c, d in frames])
+        aux = _stream(ep, [client_lib.encode_request(c, d, model="aux")
+                           for c, d in frames])
+    finally:
+        configure_faults(None)
+    assert len(seg) == len(aux) == 4
+    for r in seg:  # zero cross-model loss
+        assert r.status.startswith(("OK", "DEGRADED")), r.status
+    for r in aux:  # the faulted model fails loudly, per frame
+        assert r.status.startswith("ERROR"), r.status
+    # and the fault did not poison serving: aux recovers once disarmed
+    recovered = _stream(ep, [client_lib.encode_request(*frames[0],
+                                                       model="aux")])
+    assert recovered[0].status.startswith(("OK", "DEGRADED"))
+
+
+def test_zoo_metrics_labels(zoo_server):
+    """The hot families carry the model label (satellite): frames by
+    (status, model), per-model burn next to the aggregate."""
+    from robotic_discovery_platform_tpu.observability import (
+        exposition,
+        instruments as obs,
+    )
+
+    _, servicer, ep = zoo_server
+    _stream(ep, [client_lib.encode_request(*_frames(1)[0], model="aux")])
+    text = exposition.render()
+    assert 'rdp_frames_total{' in text
+    assert 'model="aux"' in text
+    assert 'rdp_slo_error_budget_burn{objective="e2e",model=""}' in text
+    assert 'rdp_slo_error_budget_burn{objective="e2e",model="seg"}' in text
+    assert 'rdp_slo_error_budget_burn{objective="e2e",model="aux"}' in text
+    assert "rdp_zoo_models 2" in text
+    assert 'rdp_model_dispatches_total{model="aux"}' in text
+    assert 'rdp_model_arrival_rate{model="seg"}' in text

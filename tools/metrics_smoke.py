@@ -46,6 +46,10 @@ REQUIRED_FAMILIES = (
     "rdp_geometry_cache_hits_total",
     "rdp_geometry_cache_misses_total",
     "rdp_host_stage_split_seconds",
+    # model zoo (PR 14)
+    "rdp_zoo_models",
+    "rdp_model_dispatches_total",
+    "rdp_model_arrival_rate",
 )
 #: the signals the online drift monitor must expose in /debug/drift
 DRIFT_SIGNALS = (
@@ -62,7 +66,12 @@ REQUIRED_SAMPLES = (
     'rdp_stage_latency_summary_seconds{stage="total",quantile="0.5"}',
     'rdp_frame_latency_summary_seconds{quantile="0.99"}',
     'rdp_slo_objective_seconds{objective="e2e"}',
-    'rdp_slo_error_budget_burn{objective="e2e"}',
+    # the burn family carries a model label now (model="" = aggregate)
+    'rdp_slo_error_budget_burn{objective="e2e",model=""}',
+    # per-model labels on the hot families (multi-tenancy): every frame
+    # is attributed to the zoo model that served it -- "seg" is the
+    # default binary segmenter even on a single-model server
+    'rdp_zoo_models 1',
     # every streamed frame observes its confidence margin
     "rdp_model_confidence_margin_count",
     # host-path ingest: every frame's decode work is measured and the
@@ -197,6 +206,14 @@ def main() -> int:
 
     missing = [f for f in REQUIRED_FAMILIES if f"# TYPE {f} " not in text]
     missing += [s for s in REQUIRED_SAMPLES if s not in text]
+    # per-model frame attribution: every rdp_frames_total sample names
+    # the serving zoo model (default = "seg")
+    frame_lines = [ln for ln in text.splitlines()
+                   if ln.startswith("rdp_frames_total{")]
+    if not frame_lines:
+        missing.append("rdp_frames_total{...} samples")
+    elif not all('model="' in ln for ln in frame_lines):
+        missing.append('model="..." label on every rdp_frames_total sample')
     if missing:
         print("FAIL: /metrics is missing:")
         for m in missing:
