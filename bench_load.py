@@ -339,6 +339,8 @@ def run_fleet_mode(cli, slo_ms: float, deadline_s: float | None,
         per_env[0] = {"RDP_FAULTS": cli.fleet_fault}
     replicas = replica_lib.spawn_local_replicas(
         n, uri, img_size=w, slo_ms=slo_ms, per_replica_env=per_env,
+        metrics_port=-1,  # ephemeral /metrics: the federation scrape
+                          # target for the obs-overhead legs
     )
     endpoints = [r.endpoint for r in replicas]
     replica_lib.wait_serving(endpoints)
@@ -487,8 +489,99 @@ def run_fleet_mode(cli, slo_ms: float, deadline_s: float | None,
                 channel.close()
                 f_server.stop(grace=None)
                 fe.close()
+
+        # -- observability overhead: federation + journal on vs off ------
+        # Identical arrivals against the full fleet twice: once with the
+        # observability plane quiet (journal disabled, no federated
+        # scraping) and once with it fully hot (journal on, the
+        # federator's cache poll running AND a scraper rendering
+        # /federate every 250 ms -- the realistic Prometheus load). The
+        # p99 delta is what the plane costs on the hot path; CI gates it
+        # to a small bound.
+        from robotic_discovery_platform_tpu.observability import (
+            journal as journal_lib,
+        )
+
+        obs_rows: dict[str, dict] = {}
+        federate_renders = 0
+        for leg_name, plane_on in (("obs-off", False), ("obs-on", True)):
+            fcfg = ServerConfig(
+                address="localhost:0",
+                fleet_replicas=",".join(endpoints),
+                fleet_poll_s=0.15,
+                fleet_probe_timeout_s=1.0,
+                fleet_breaker_failures=1,
+                fleet_breaker_reset_s=1.0,
+            )
+            f_server, fe = frontend_lib.build_frontend(fcfg)
+            fport = f_server.add_insecure_port("localhost:0")
+            f_server.start()
+            channel = grpc.insecure_channel(f"localhost:{fport}")
+            stub = vision_grpc.VisionAnalysisServiceStub(channel)
+            journal_lib.JOURNAL.set_enabled(plane_on)
+            scraper_stop = threading.Event()
+            scraper = None
+            if plane_on:
+                fe.federator.start()  # the last-good cache poll
+
+                def scrape_loop(fed=fe.federator):
+                    while not scraper_stop.wait(0.25):
+                        try:
+                            fed.render()
+                        except Exception:  # noqa: BLE001 - keep scraping
+                            pass
+
+                scraper = threading.Thread(target=scrape_loop,
+                                           daemon=True)
+                scraper.start()
+            try:
+                if not fe.router.wait_live(n, timeout_s=60):
+                    raise RuntimeError(
+                        f"leg {leg_name}: fleet never became placeable")
+                warm_errors += _warm_fleet(stub, request, fe, endpoints)
+                rng = np.random.default_rng(cli.seed)
+                arrivals = poisson_arrivals(loads[-1], duration, rng)
+                lat_ms, errors, wall = run_level(
+                    stub, request, arrivals, cli.workers, deadline_s)
+                row = summarize_level(lat_ms, errors, loads[-1], wall,
+                                      slo_ms)
+                row["fleet_leg"] = leg_name
+                row["replicas"] = n
+                rows.append(row)
+                obs_rows[leg_name] = row
+                if plane_on:
+                    federate_renders = fe.federator.renders
+                print(f"# fleet leg={leg_name} offered={loads[-1]:.1f}rps "
+                      f"n={len(lat_ms)} errors={errors} "
+                      f"p99={row['p99_ms']}", file=sys.stderr)
+            finally:
+                scraper_stop.set()
+                if scraper is not None:
+                    scraper.join(timeout=5)
+                journal_lib.JOURNAL.set_enabled(True)
+                channel.close()
+                f_server.stop(grace=None)
+                fe.close()
     finally:
         replica_lib.stop_replicas(replicas)
+
+    p99_off = obs_rows.get("obs-off", {}).get("p99_ms")
+    p99_on = obs_rows.get("obs-on", {}).get("p99_ms")
+    p50_off = obs_rows.get("obs-off", {}).get("p50_ms")
+    p50_on = obs_rows.get("obs-on", {}).get("p50_ms")
+    obs_overhead = {
+        "p99_off_ms": p99_off,
+        "p99_on_ms": p99_on,
+        "delta_ms": (round(p99_on - p99_off, 3)
+                     if p99_on is not None and p99_off is not None
+                     else None),
+        "p50_off_ms": p50_off,
+        "p50_on_ms": p50_on,
+        "p50_delta_ms": (round(p50_on - p50_off, 3)
+                         if p50_on is not None and p50_off is not None
+                         else None),
+        "federate_renders": federate_renders,
+    }
 
     one = leg_summaries.get("1-replica", {})
     full = leg_summaries.get(f"{n}-replica", {})
@@ -500,6 +593,7 @@ def run_fleet_mode(cli, slo_ms: float, deadline_s: float | None,
                                3)
                          if one.get("goodput_rps") else None),
         "fault": cli.fleet_fault or None,
+        "obs_overhead": obs_overhead,
     }
 
     payload = {

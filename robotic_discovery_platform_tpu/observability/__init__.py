@@ -29,6 +29,15 @@ analysis -> resilience -> observability triad:
 - :mod:`slo` -- latency objectives (``ServerConfig.slo_ms`` /
   ``RDP_SLO_MS``), violation counting, and error-budget burn -- the
   signals the SLO-aware scheduler will consume.
+- :mod:`journal` -- the structured event journal: one bounded
+  append-only log of control-plane events (breaker/quarantine
+  transitions, controller and rollout actions, drift recommendations,
+  watchdog restarts, fleet membership/failovers) with a monotonic
+  cursor, trace-ID stamping, and ``GET /debug/events?since=``.
+- :mod:`federation` -- fleet metrics federation: the front-end scrapes
+  every replica's families and re-exposes them under a ``replica`` label
+  with ``rdp_replica_up``/staleness markers and fleet roll-ups at
+  ``GET /federate`` -- one Prometheus target for the whole fleet.
 """
 
 from robotic_discovery_platform_tpu.observability.registry import (

@@ -10,9 +10,23 @@ curl, and a wedged gRPC thread pool cannot take the diagnostics surface
 down with it. It serves:
 
 - ``GET /metrics`` -- the Prometheus scrape;
+- ``GET /federate`` -- the fleet-federated scrape (front-end only): every
+  live replica's families under a ``replica`` label plus
+  ``rdp_replica_up`` / staleness markers and the fleet roll-ups
+  (observability/federation.py). Installed via
+  :meth:`MetricsServer.set_federation_provider`;
 - ``GET /debug/spans`` -- the flight recorder's recent + pinned dispatch
   timelines as JSON (observability/recorder.py);
 - ``GET /debug/tracez`` -- the tracez-style per-span-name rollup;
+- ``GET /debug/trace?id=<trace_id>`` -- one trace's stitched cross-host
+  view (front-end only): the front-end's relay timelines merged with
+  every replica's matching dispatch timelines into a single distributed
+  tree. Installed via :meth:`MetricsServer.set_trace_provider`;
+- ``GET /debug/events?since=<cursor>`` -- the structured event journal
+  (observability/journal.py): breaker/quarantine transitions, controller
+  and rollout actions, drift recommendations, watchdog restarts, fleet
+  membership and failover decisions, in causal order with a monotonic
+  resume cursor;
 - ``GET /debug/drift`` -- the online drift monitor's state as JSON
   (live vs reference histograms, per-signal PSI/JS scores, the
   recommendation ladder; monitoring/profile.py). The serving layer
@@ -43,6 +57,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from robotic_discovery_platform_tpu.observability import (
+    journal as journal_lib,
     recorder as recorder_lib,
 )
 from robotic_discovery_platform_tpu.observability.registry import (
@@ -111,10 +126,13 @@ class MetricsServer:
                  host: str = "0.0.0.0",
                  flight_recorder: "recorder_lib.FlightRecorder | None" = None,
                  profile_dir: str | None = None,
-                 drift_provider=None):
+                 drift_provider=None,
+                 journal: "journal_lib.EventJournal | None" = None):
         self._registry = registry
         self._recorder = (flight_recorder if flight_recorder is not None
                           else recorder_lib.RECORDER)
+        self._journal = (journal if journal is not None
+                         else journal_lib.JOURNAL)
         self._profile_dir = profile_dir
         # () -> JSON-able dict; installed after construction by the
         # serving layer (the servicer owns the DriftMonitor and is built
@@ -124,22 +142,61 @@ class MetricsServer:
         self._rollout_provider = None
         # and for the model zoo + placer (serving/zoo.py)
         self._zoo_provider = None
+        # fleet-only surfaces (front-end process): a (trace_id) -> dict
+        # stitcher behind /debug/trace and a () -> exposition-text
+        # federator behind /federate (observability/federation.py)
+        self._trace_provider = None
+        self._federation_provider = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server contract)
                 path, _, query = self.path.partition("?")
                 if path == "/metrics":
-                    body = render(outer._registry).encode("utf-8")
-                    self.send_response(200)
-                    self.send_header("Content-Type", CONTENT_TYPE)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send_text(render(outer._registry))
+                elif path == "/federate":
+                    provider = outer._federation_provider
+                    if provider is None:
+                        self._send_json({
+                            "enabled": False,
+                            "reason": "no fleet federator attached (the "
+                                      "federated scrape lives on the "
+                                      "fleet front-end's metrics port)",
+                        }, status=404)
+                    else:
+                        self._send_text(provider())
                 elif path == "/debug/spans":
                     self._send_json(outer._recorder.snapshot())
                 elif path == "/debug/tracez":
                     self._send_json(outer._recorder.summary())
+                elif path == "/debug/trace":
+                    provider = outer._trace_provider
+                    if provider is None:
+                        self._send_json({
+                            "enabled": False,
+                            "reason": "no trace stitcher attached "
+                                      "(cross-host stitching lives on "
+                                      "the fleet front-end; a replica's "
+                                      "own timelines are /debug/spans)",
+                        }, status=404)
+                        return
+                    trace_id = parse_qs(query).get("id", [""])[0]
+                    if not trace_id.strip():
+                        self._send_json(
+                            {"error": "missing ?id=<32-hex trace id>"},
+                            status=400)
+                        return
+                    self._send_json(provider(trace_id.strip()))
+                elif path == "/debug/events":
+                    raw = parse_qs(query).get("since", ["0"])[0]
+                    try:
+                        since = int(raw)
+                    except ValueError:
+                        self._send_json(
+                            {"error": f"bad since cursor {raw!r}"},
+                            status=400)
+                        return
+                    self._send_json(outer._journal.snapshot(since))
                 elif path == "/debug/drift":
                     provider = outer._drift_provider
                     if provider is None:
@@ -176,9 +233,19 @@ class MetricsServer:
                     self._profile(query)
                 else:
                     self.send_error(
-                        404, "try /metrics, /debug/spans, /debug/tracez, "
-                             "/debug/drift, /debug/rollout, /debug/zoo, "
+                        404, "try /metrics, /federate, /debug/spans, "
+                             "/debug/tracez, /debug/trace?id=TRACE_ID, "
+                             "/debug/events?since=N, /debug/drift, "
+                             "/debug/rollout, /debug/zoo, "
                              "or /debug/profile?seconds=N")
+
+            def _send_text(self, text: str, status: int = 200):
+                body = text.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _send_json(self, payload: dict, status: int = 200):
                 body = json.dumps(payload, indent=1).encode("utf-8")
@@ -249,6 +316,18 @@ class MetricsServer:
         zero-arg callable returning a JSON-able dict -- the servicer's
         ``zoo_debug``: roster, placement, rate correlations, warm set)."""
         self._zoo_provider = provider
+
+    def set_trace_provider(self, provider) -> None:
+        """Install (or clear) the ``GET /debug/trace?id=`` stitcher: a
+        callable taking one trace ID and returning a JSON-able dict (the
+        fleet front-end's cross-host stitched view)."""
+        self._trace_provider = provider
+
+    def set_federation_provider(self, provider) -> None:
+        """Install (or clear) the ``GET /federate`` payload source: a
+        zero-arg callable returning Prometheus exposition TEXT (the
+        fleet federator's re-labeled + rolled-up scrape)."""
+        self._federation_provider = provider
 
     def start(self) -> "MetricsServer":
         if self._thread is None:

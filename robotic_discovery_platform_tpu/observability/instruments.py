@@ -15,7 +15,12 @@ same functions).
 
 from __future__ import annotations
 
-from robotic_discovery_platform_tpu.observability.registry import REGISTRY
+from robotic_discovery_platform_tpu.observability import (
+    journal as journal_lib,
+)
+from robotic_discovery_platform_tpu.observability.registry import (
+    REGISTRY,
+)
 
 # -- serving -----------------------------------------------------------------
 
@@ -465,6 +470,65 @@ FLEET_CONTROLLER_ACTIONS = REGISTRY.counter(
     ("action",),
 )
 
+# -- fleet observability plane (observability/federation.py + journal.py) ----
+
+REPLICA_UP = REGISTRY.gauge(
+    "rdp_replica_up",
+    "Per-replica scrape health on the front-end's federated metrics "
+    "endpoint (GET /federate): 1 = this render scraped the replica's "
+    "/metrics live, 0 = unreachable (its last good families are "
+    "re-served stale; see rdp_replica_scrape_age_seconds).",
+    ("replica",),
+)
+REPLICA_SCRAPE_AGE = REGISTRY.gauge(
+    "rdp_replica_scrape_age_seconds",
+    "Age of the newest /metrics+/debug/spans scrape the federator holds "
+    "for each replica (staleness marker for dead or draining members; "
+    "-1 = never scraped).",
+    ("replica",),
+)
+REPLICA_DRAINING = REGISTRY.gauge(
+    "rdp_replica_draining",
+    "Per-replica draining flag as last scraped over the stats RPC "
+    "(1 = healthy but out of new-stream placement; the aggregate count "
+    "is rdp_fleet_replicas_draining).",
+    ("replica",),
+)
+FLEET_BURN = REGISTRY.gauge(
+    "rdp_fleet_burn",
+    "Fleet-level error-budget burn roll-up over the live replicas' "
+    "scraped rdp_slo_error_budget_burn readings (stat = mean, max) -- "
+    "the capacity planner's aggregate demand-vs-capacity signal.",
+    ("stat",),
+)
+FLEET_FRAMES = REGISTRY.gauge(
+    "rdp_fleet_frames",
+    "Total frames served across the fleet (sum of each replica's "
+    "frames_total as last scraped over the stats RPC).",
+)
+FLEET_MODEL_ARRIVAL_RATE = REGISTRY.gauge(
+    "rdp_fleet_model_arrival_rate",
+    "Per-model arrival rate summed across replicas (frames/sec over "
+    "each replica's ZooPlacer rate window) -- the capacity planner's "
+    "fleet-wide per-model demand input.",
+    ("model",),
+)
+JOURNAL_EVENTS = REGISTRY.counter(
+    "rdp_journal_events_total",
+    "Structured events appended to the observability journal "
+    "(GET /debug/events), by kind: breaker.transition, chip.quarantine, "
+    "chip.reinstate, controller.action, fleet.membership, fleet.drain, "
+    "fleet.failover, rollout.transition, drift.recommendation, "
+    "watchdog.restart, zoo.rebalance, server.ready, server.drain.",
+    ("kind",),
+)
+JOURNAL_DROPPED = REGISTRY.counter(
+    "rdp_journal_dropped_total",
+    "Events the bounded journal ring evicted to make room (a consumer "
+    "tailing /debug/events?since= sees the gap as a non-zero 'dropped' "
+    "field; size the ring with RDP_JOURNAL_RING).",
+)
+
 # -- resilience --------------------------------------------------------------
 
 #: closed=0 / open=1 / half_open=2 (alert on `rdp_breaker_state == 1`).
@@ -515,6 +579,11 @@ def _on_breaker_transition(name: str, old: str | None, new: str) -> None:
     )
     if old is not None:  # creation announces state without a transition
         BREAKER_TRANSITIONS.labels(breaker=name, to=new).inc()
+        # every breaker transition (registry, per-chip quarantine,
+        # per-replica fleet quarantine) is a journal event: an open
+        # breaker IS the quarantine record incident reconstruction reads
+        journal_lib.JOURNAL.append(
+            "breaker.transition", breaker=name, frm=old, to=new)
 
 
 def _on_retry(site: str | None, attempt: int) -> None:
@@ -528,4 +597,14 @@ def install_resilience_hooks() -> None:
     policy.set_retry_observer(_on_retry)
 
 
+def install_journal_hooks() -> None:
+    """Route the journal's per-event counting into the registry (the
+    journal stays import-clean of it, same pattern as resilience)."""
+    journal_lib.set_observer(
+        lambda kind: JOURNAL_EVENTS.labels(kind=kind).inc(),
+        lambda n: JOURNAL_DROPPED.inc(n),
+    )
+
+
 install_resilience_hooks()
+install_journal_hooks()

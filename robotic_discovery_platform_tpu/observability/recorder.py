@@ -33,7 +33,10 @@ import time
 from collections import deque
 from typing import Iterable
 
-from robotic_discovery_platform_tpu.observability.trace import SpanRecord
+from robotic_discovery_platform_tpu.observability.trace import (
+    SpanRecord,
+    identity,
+)
 from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 
 #: tracez-style latency buckets (ms) for the /debug/tracez summary
@@ -155,9 +158,15 @@ class FlightRecorder:
             return list(self._pinned)
 
     def snapshot(self) -> dict:
-        """The /debug/spans payload: recent + pinned, JSON-ready."""
+        """The /debug/spans payload: recent + pinned, JSON-ready. Carries
+        the process identity at top level (and every span carries its
+        own host/role) so merged multi-process output -- the front-end's
+        stitched ``/debug/trace`` -- stays attributable."""
         recent = self.timelines()
+        host, role = identity()
         return {
+            "host": host,
+            "role": role,
             "capacity": self._capacity,
             "recorded_total": (recent[-1].seq + 1) if recent else 0,
             "recent": [t.to_dict() for t in recent],
@@ -168,8 +177,36 @@ class FlightRecorder:
         """tracez-style rollup over the ring + pinned set: per span name,
         the count, how many rode an errored timeline, the max duration,
         and a small latency histogram -- the 10-second read before
-        opening full timelines."""
+        opening full timelines. ``groups`` repeats the rollup keyed by
+        each span's ``role@host`` identity, so a summary over merged
+        multi-process timelines splits per producer."""
+
+        def _blank_row() -> dict:
+            return {
+                "count": 0, "errors": 0, "max_ms": 0.0,
+                "latency_ms_le": {
+                    **{str(b): 0 for b in TRACEZ_BOUNDS_MS},
+                    "+Inf": 0,
+                },
+            }
+
+        def _fold(row: dict, sp: SpanRecord, errored: bool) -> None:
+            row["count"] += 1
+            if errored:
+                row["errors"] += 1
+            dur = sp.duration_ms
+            if dur is None:
+                return
+            row["max_ms"] = max(row["max_ms"], dur)
+            for b in TRACEZ_BOUNDS_MS:
+                if dur <= b:
+                    row["latency_ms_le"][str(b)] += 1
+                    break
+            else:
+                row["latency_ms_le"]["+Inf"] += 1
+
         rows: dict[str, dict] = {}
+        groups: dict[str, dict] = {}
         seen: set[int] = set()
         all_tl: Iterable[Timeline] = [*self.timelines(), *self.pinned()]
         for tl in all_tl:
@@ -177,27 +214,13 @@ class FlightRecorder:
                 continue
             seen.add(id(tl))
             for sp in tl.spans:
-                row = rows.setdefault(sp.name, {
-                    "count": 0, "errors": 0, "max_ms": 0.0,
-                    "latency_ms_le": {
-                        **{str(b): 0 for b in TRACEZ_BOUNDS_MS},
-                        "+Inf": 0,
-                    },
-                })
-                row["count"] += 1
-                if tl.error is not None:
-                    row["errors"] += 1
-                dur = sp.duration_ms
-                if dur is None:
-                    continue
-                row["max_ms"] = max(row["max_ms"], dur)
-                for b in TRACEZ_BOUNDS_MS:
-                    if dur <= b:
-                        row["latency_ms_le"][str(b)] += 1
-                        break
-                else:
-                    row["latency_ms_le"]["+Inf"] += 1
-        return {"spans": rows, "timelines": len(seen)}
+                errored = tl.error is not None
+                _fold(rows.setdefault(sp.name, _blank_row()), sp, errored)
+                group = groups.setdefault(
+                    f"{sp.role or '-'}@{sp.host or '-'}", {"spans": {}})
+                _fold(group["spans"].setdefault(sp.name, _blank_row()),
+                      sp, errored)
+        return {"spans": rows, "groups": groups, "timelines": len(seen)}
 
 
 def _default_capacity() -> int:

@@ -23,11 +23,39 @@ import contextvars
 import logging
 import os
 import re
+import socket
 import time
 from dataclasses import dataclass, field
 from typing import Iterable
 
 TRACEPARENT = "traceparent"
+
+# -- process identity --------------------------------------------------------
+#
+# Fleet observability merges span and event output from N processes (the
+# front-end stitches /debug/trace across replicas); every recorded span
+# and journal event is stamped with WHERE it happened so the merged view
+# stays attributable. Identity is per-process on purpose -- "replica" vs
+# "frontend" is a deployment role, and one process plays one role.
+
+_host: str = f"{socket.gethostname()}:{os.getpid()}"
+_role: str = "process"
+
+
+def set_identity(host: str | None = None, role: str | None = None) -> None:
+    """Declare this process's observability identity. ``build_server``
+    sets role="replica", ``build_frontend`` sets role="frontend"; the
+    host defaults to ``hostname:pid`` (unique per process on one box)."""
+    global _host, _role
+    if host is not None:
+        _host = str(host)
+    if role is not None:
+        _role = str(role)
+
+
+def identity() -> tuple[str, str]:
+    """The (host, role) pair stamped onto spans and journal events."""
+    return _host, _role
 
 _TP_RE = re.compile(
     r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
@@ -95,6 +123,11 @@ class SpanRecord:
     start_ns: int = 0
     end_ns: int | None = None
     attributes: dict[str, str] = field(default_factory=dict)
+    # stamped at creation from the process identity: merged multi-process
+    # span output (the front-end's stitched /debug/trace) stays
+    # attributable to the host and role that produced each span
+    host: str = field(default_factory=lambda: _host)
+    role: str = field(default_factory=lambda: _role)
 
     def end(self, ns: int | None = None) -> "SpanRecord":
         self.end_ns = time.monotonic_ns() if ns is None else int(ns)
@@ -116,6 +149,8 @@ class SpanRecord:
             "end_ns": self.end_ns,
             "duration_ms": self.duration_ms,
             "attributes": dict(self.attributes),
+            "host": self.host,
+            "role": self.role,
         }
 
 

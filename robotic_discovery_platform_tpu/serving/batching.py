@@ -145,6 +145,7 @@ import numpy as np
 from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 from robotic_discovery_platform_tpu.observability import (
     instruments as obs,
+    journal as journal_lib,
     recorder as recorder_lib,
     trace,
 )
@@ -406,6 +407,8 @@ class DeviceRouter:
                 live = len(self._quarantined)
             if reinstated:
                 obs.QUARANTINED_CHIPS.set(live)
+                journal_lib.JOURNAL.append(
+                    "chip.reinstate", chip=chip, quarantined=live)
                 log.info("chip %d reinstated after successful probe "
                          "dispatch", chip)
                 if self.on_health is not None:
@@ -445,6 +448,10 @@ class DeviceRouter:
         if newly:
             obs.QUARANTINED_CHIPS.set(live)
             obs.CHIP_QUARANTINES.labels(chip=str(chip)).inc()
+            journal_lib.JOURNAL.append(
+                "chip.quarantine", chip=chip, quarantined=live,
+                error=str(exc) if exc is not None else "unknown",
+            )
             log.error(
                 "chip %d quarantined after repeated dispatch failures "
                 "(%s); failing its in-flight frames over to %d healthy "
@@ -1027,6 +1034,10 @@ class BatchDispatcher:
                     "watchdog_restart", stage=dead,
                     error=f"batch {dead} thread died; "
                           f"{len(self._pending)} pending frame(s) failed",
+                )
+                journal_lib.JOURNAL.append(
+                    "watchdog.restart", stage=dead,
+                    pending=len(self._pending),
                 )
                 log.error(
                     "batch %s thread died unexpectedly; failing %d "

@@ -74,7 +74,10 @@ import threading
 import time
 from typing import Any, Callable
 
-from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.observability import (
+    instruments as obs,
+    journal as journal_lib,
+)
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -233,6 +236,10 @@ class ReactiveController:
                 # must re-sustain before the next action
                 self._high_since = self._low_since = None
                 obs.CONTROLLER_ACTIONS.labels(action=action).inc()
+                journal_lib.JOURNAL.append(
+                    "controller.action", action=action,
+                    level=self.level, burn=round(burn, 3),
+                )
                 log.info("controller action: %s (burn %.2f, level %d)",
                          action, burn, self.level)
         if d is not None:

@@ -56,7 +56,10 @@ from typing import Callable
 
 import grpc
 
-from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.observability import (
+    instruments as obs,
+    journal as journal_lib,
+)
 from robotic_discovery_platform_tpu.resilience import CircuitBreaker
 from robotic_discovery_platform_tpu.resilience.breaker import CLOSED
 from robotic_discovery_platform_tpu.serving import health as health_lib
@@ -196,6 +199,19 @@ class Replica:
         self.weight = 1.0
         #: last full stats payload (diagnostics)
         self.stats: dict = {}
+        #: metrics-exposition port the replica advertised over the stats
+        #: RPC (0 = none); the federation/trace-stitch scrapes need it
+        self.metrics_port = 0
+
+    @property
+    def metrics_base_url(self) -> str | None:
+        """Base URL of this replica's metrics server (federated scrape +
+        /debug/spans stitching target), once the stats RPC has
+        advertised a port."""
+        if not self.metrics_port or self.metrics_port <= 0:
+            return None
+        host = self.endpoint.rsplit(":", 1)[0] or "localhost"
+        return f"http://{host}:{self.metrics_port}"
 
     # -- wiring (lazy) ------------------------------------------------------
 
@@ -381,6 +397,12 @@ class FleetRouter:
                     "joined" if r.placeable else "dropped out",
                     "healthy" if healthy else exc,
                 )
+                journal_lib.JOURNAL.append(
+                    "fleet.membership",
+                    replica=r.endpoint,
+                    state="joined" if r.placeable else "dropped",
+                    reason="healthy" if healthy else str(exc),
+                )
             if r.serving:
                 self._scrape_stats(r)
             else:
@@ -403,6 +425,10 @@ class FleetRouter:
             r.burn = float(stats.get("burn", 0.0))
         except (TypeError, ValueError):
             r.burn = 0.0
+        try:
+            r.metrics_port = int(stats.get("metrics_port", 0) or 0)
+        except (TypeError, ValueError):
+            r.metrics_port = 0
         was_draining = r.draining
         r.draining = bool(stats.get("draining", False))
         if r.draining != was_draining:
@@ -411,6 +437,10 @@ class FleetRouter:
                 "still SERVING)", r.endpoint,
                 "draining -- out of new-stream placement" if r.draining
                 else "un-drained -- placeable again",
+            )
+            journal_lib.JOURNAL.append(
+                "fleet.drain", replica=r.endpoint,
+                state="draining" if r.draining else "undrained",
             )
         obs.FLEET_REPLICA_BURN.labels(replica=r.endpoint).set(r.burn)
 

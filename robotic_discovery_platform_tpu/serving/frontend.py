@@ -34,13 +34,33 @@ SERVING while at least one replica is placeable, NOT_SERVING otherwise --
 so front-ends themselves compose (a load balancer can health-gate them the
 same way they health-gate replicas).
 
+Observability plane (the fleet's one-stop view):
+
+- every relayed frame records a **relay timeline** in the front-end's
+  flight recorder (accept -> send [-> failover -> re-send] -> answer),
+  parented under the client's trace context -- and the client's original
+  ``traceparent`` is forwarded on EVERY failover attempt (minted by the
+  front-end when the client sent none), so one trace ID follows a frame
+  across replicas;
+- ``GET /debug/trace?id=<trace_id>`` on the front-end's metrics port
+  stitches those relay timelines with every replica's matching dispatch
+  timelines (scraped from their ``/debug/spans``, last-good-cached so a
+  dead replica's evidence survives it) into ONE distributed tree;
+- ``GET /federate`` re-exposes every replica's metric families under a
+  ``replica`` label with ``rdp_replica_up``/staleness markers and fleet
+  roll-ups (observability/federation.py);
+- membership changes, drains, and failover decisions land in the
+  structured event journal (``GET /debug/events?since=``).
+
 Like fleet.py, this module never imports jax: the front-end routes bytes.
 """
 
 from __future__ import annotations
 
 import queue
+import re
 import threading
+import time
 from collections import deque
 from concurrent import futures
 
@@ -48,6 +68,9 @@ import grpc
 
 from robotic_discovery_platform_tpu.observability import (
     exposition,
+    federation as federation_lib,
+    journal as journal_lib,
+    recorder as recorder_lib,
     trace,
 )
 from robotic_discovery_platform_tpu.serving import (
@@ -73,38 +96,183 @@ _FORWARDED_METADATA = (trace.TRACEPARENT,)
 #: (a retired feeder must notice the failover and stand down)
 _FEED_POLL_S = 0.05
 
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+
+
+def _matching_timelines(snapshot: dict, trace_id: str) -> list[dict]:
+    """Timelines (recent + pinned, deduped by seq) holding at least one
+    span of ``trace_id``, from a /debug/spans-shaped payload."""
+    out: list[dict] = []
+    seen: set[int] = set()
+    for section in ("recent", "pinned"):
+        for tl in snapshot.get(section, []) or []:
+            if tl.get("seq") in seen:
+                continue
+            if any(s.get("trace_id") == trace_id
+                   for s in tl.get("spans", [])):
+                seen.add(tl.get("seq"))
+                out.append(tl)
+    out.sort(key=lambda t: t.get("created_unix_s") or 0.0)
+    return out
+
+
+def _span_forest(spans: list[dict]) -> list[dict]:
+    """Nest flat span records by their parent links (roots first, each
+    with a ``children`` list); orphaned parents degrade to roots."""
+    by_id = {s.get("span_id"): {**s, "children": []} for s in spans}
+    roots: list[dict] = []
+    for node in by_id.values():
+        parent = by_id.get(node.get("parent_id"))
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    return roots
+
+
+def _stitch_tree(trace_id: str, sources: list[dict]) -> dict:
+    """One distributed tree: a synthetic trace root whose children are
+    the per-process sources (role/host/endpoint), each holding its
+    matching timelines with spans nested by parent link. Cross-host
+    ordering uses wall-clock ``created_unix_s`` (monotonic_ns stamps are
+    not comparable across processes)."""
+    children = []
+    for src in sources:
+        if not src["timelines"]:
+            continue
+        children.append({
+            "role": src["role"],
+            "host": src["host"],
+            "endpoint": src["endpoint"],
+            "stale": not src["fresh"],
+            "timelines": [
+                {
+                    "name": tl.get("name"),
+                    "seq": tl.get("seq"),
+                    "labels": tl.get("labels", {}),
+                    "error": tl.get("error"),
+                    "created_unix_s": tl.get("created_unix_s"),
+                    "duration_ms": tl.get("duration_ms"),
+                    "spans": _span_forest(tl.get("spans", [])),
+                }
+                for tl in src["timelines"]
+            ],
+        })
+    return {"trace_id": trace_id, "children": children}
+
+
+class _RelayFrame:
+    """One accepted frame riding the relay, plus its flight-recorder
+    timeline (accept -> send [-> failover -> re-send] -> answer).
+
+    Span ownership follows the frame's ownership hand-off: the feeder
+    opens spans before the frame becomes visible to the response loop
+    (appended to ``pending`` under the stream lock), the response loop
+    or the failover handler closes them -- never both at once, so span
+    mutation needs no lock of its own."""
+
+    __slots__ = ("req", "accept_ns", "timeline", "root", "send_span",
+                 "attempts")
+
+    def __init__(self, req):
+        self.req = req
+        self.accept_ns = time.monotonic_ns()
+        self.timeline: recorder_lib.Timeline | None = None
+        self.root = None
+        self.send_span = None
+        self.attempts = 0
+
+    def ensure_started(self, trace_id: str | None) -> None:
+        """Open the timeline on first send (idempotent: a stashed frame
+        re-fed by a later attempt keeps its original accept span)."""
+        if self.timeline is not None:
+            return
+        tl = recorder_lib.Timeline("relay")
+        now = time.monotonic_ns()
+        self.root = tl.span("relay", start_ns=self.accept_ns,
+                            trace_id=trace_id)
+        tl.span("accept", start_ns=self.accept_ns, end_ns=now,
+                parent=self.root, trace_id=trace_id)
+        self.timeline = tl
+
+    def begin_send(self, endpoint: str, trace_id: str | None) -> None:
+        self.ensure_started(trace_id)
+        self.attempts += 1
+        self.send_span = self.timeline.span(
+            "send", start_ns=time.monotonic_ns(), parent=self.root,
+            trace_id=trace_id, replica=endpoint, attempt=self.attempts,
+        )
+
+    def mark_failover(self, frm: str, to: str, trace_id: str | None,
+                      why: str) -> None:
+        """Close the dead attempt's send span and stamp the hop itself
+        as a point span -- the 'failover hop' the stitched /debug/trace
+        shows."""
+        now = time.monotonic_ns()
+        if self.send_span is not None and self.send_span.end_ns is None:
+            self.send_span.end(now)
+            self.send_span.attributes["error"] = why
+        self.ensure_started(trace_id)
+        self.timeline.span("failover", start_ns=now, end_ns=now,
+                           parent=self.root, trace_id=trace_id,
+                           frm=frm, to=to, reason=why)
+
+    def finish(self, recorder: recorder_lib.FlightRecorder,
+               error: str | None = None) -> None:
+        """Answer delivered (or error-completed): close the open spans
+        and hand the timeline to the recorder (errored timelines pin)."""
+        if self.timeline is None:
+            return
+        now = time.monotonic_ns()
+        if self.send_span is not None and self.send_span.end_ns is None:
+            self.send_span.end(now)
+        if self.root is not None and self.root.end_ns is None:
+            self.root.end(now)
+        self.timeline.labels["attempts"] = str(self.attempts)
+        if error is not None:
+            self.timeline.fail(error)
+        recorder.record(self.timeline)
+        self.timeline = None  # record exactly once
+
 
 class _StreamState:
     """Shared state of one relayed client stream across failover attempts."""
 
     __slots__ = ("lock", "inbox", "pending", "stash", "client_done",
-                 "closed", "gen", "pump_error")
+                 "closed", "gen", "pump_error", "trace_id")
 
-    def __init__(self, inbox_depth: int = 64):
+    def __init__(self, inbox_depth: int = 64,
+                 trace_id: str | None = None):
         self.lock = checked_lock("frontend.stream")
         # bounded: a slow replica backpressures the pump thread, and gRPC
         # flow control pushes that back to the client
         self.inbox: queue.Queue = queue.Queue(maxsize=inbox_depth)
         #: sent to the current replica, response not yet relayed
-        self.pending: deque = deque()  # guarded_by: lock
+        self.pending: deque[_RelayFrame] = deque()  # guarded_by: lock
         #: pulled from the inbox by a retired feeder after its attempt
         #: died; the next attempt's feeder drains this first
-        self.stash: deque = deque()  # guarded_by: lock
+        self.stash: deque[_RelayFrame] = deque()  # guarded_by: lock
         self.client_done = False
         self.closed = False
         #: failover generation; a feeder retires when it no longer matches
         self.gen = 0
         self.pump_error: BaseException | None = None
+        #: the stream's trace ID (client's traceparent, or front-end
+        #: minted) stamped onto every relay span
+        self.trace_id = trace_id
 
 
 def _pump(request_iterator, st: _StreamState) -> None:
     """Client-side pump: the ONE consumer of the client request iterator,
-    so failover attempts never race over it."""
+    so failover attempts never race over it. Each request is wrapped in
+    a :class:`_RelayFrame` here -- acceptance is where the frame's relay
+    timeline starts."""
     try:
         for req in request_iterator:
+            frame = _RelayFrame(req)
             while True:
                 try:
-                    st.inbox.put(req, timeout=0.1)
+                    st.inbox.put(frame, timeout=0.1)
                     break
                 except queue.Full:
                     if st.closed:
@@ -120,13 +288,26 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
     state lives on the stack of each handler."""
 
     def __init__(self, router: fleet_lib.FleetRouter,
-                 cfg: ServerConfig = ServerConfig()):
+                 cfg: ServerConfig = ServerConfig(),
+                 flight_recorder: recorder_lib.FlightRecorder | None = None):
         self.router = router
         self.cfg = cfg
         self.health = health_lib.HealthServicer()
         self.health.set(vision_grpc.SERVICE_NAME, health_lib.NOT_SERVING)
         router.on_membership = self._on_membership
         self.metrics_server: exposition.MetricsServer | None = None
+        #: where relay timelines land (GET /debug/spans on the front-end)
+        self.recorder = (flight_recorder if flight_recorder is not None
+                         else recorder_lib.RECORDER)
+        #: the fleet scrape cache + /federate renderer; its background
+        #: poll starts with the metrics server (build_frontend) so the
+        #: last-good evidence of a replica that dies between queries is
+        #: already cached when /debug/trace asks for it
+        self.federator = federation_lib.FleetFederator(
+            self._scrape_targets,
+            timeout_s=cfg.fleet_probe_timeout_s,
+            poll_s=max(cfg.fleet_poll_s, 0.25),
+        )
         # optional drift-triggered rollout supervisor (serving/rollout.py;
         # duck-typed so this module stays jax-free): set via
         # set_rollout_manager, stopped with the front-end, surfaced at
@@ -153,27 +334,87 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
         self.health.set("", status)
         self.health.set(vision_grpc.SERVICE_NAME, status)
 
+    # -- observability plane --------------------------------------------------
+
+    def _scrape_targets(self) -> list[federation_lib.ScrapeTarget]:
+        """The federator's view of the fleet: every configured replica
+        (live or not -- a dead member must still be marked, not
+        omitted), its advertised metrics URL, and its last stats
+        payload."""
+        return [
+            federation_lib.ScrapeTarget(
+                replica=r.endpoint,
+                base_url=r.metrics_base_url,
+                stats=r.stats,
+            )
+            for r in self.router.replicas
+        ]
+
+    def trace_debug(self, trace_id: str) -> dict:
+        """The ``GET /debug/trace?id=`` stitcher: the front-end's relay
+        timelines for this trace merged with every replica's matching
+        dispatch/ingest timelines (live-scraped, falling back to the
+        federator's last-good cache for dead members) into one
+        distributed tree keyed by the trace ID."""
+        tid = (trace_id or "").strip().lower()
+        if not _TRACE_ID_RE.match(tid):
+            return {"error": f"bad trace id {trace_id!r} "
+                             "(want 32 lowercase hex chars)"}
+        host, role = trace.identity()
+        sources = [{
+            "role": "frontend",
+            "host": host,
+            "endpoint": None,
+            "fresh": True,
+            "scrape_age_s": 0.0,
+            "timelines": _matching_timelines(self.recorder.snapshot(),
+                                             tid),
+        }]
+        for target, payload, age_s, fresh in self.federator.span_payloads():
+            source = {
+                "role": (payload or {}).get("role", "replica"),
+                "host": (payload or {}).get("host", ""),
+                "endpoint": target.replica,
+                "fresh": fresh,
+                "scrape_age_s": age_s,
+                "timelines": (_matching_timelines(payload, tid)
+                              if payload is not None else []),
+            }
+            if payload is None:
+                source["error"] = "unreachable and never scraped"
+            sources.append(source)
+        return {
+            "trace_id": tid,
+            "timelines_total": sum(len(s["timelines"]) for s in sources),
+            "sources": sources,
+            "tree": _stitch_tree(tid, sources),
+        }
+
     # -- the relay -----------------------------------------------------------
 
-    def _feed(self, st: _StreamState, gen: int, resend: list):
+    def _feed(self, st: _StreamState, gen: int, resend: list,
+              endpoint: str):
         """Request generator for ONE failover attempt: re-sends the
         pending window first (already in ``st.pending``), then relays new
         frames -- each appended to ``pending`` before it is yielded, so a
-        frame gRPC pulled but never delivered is still accounted for."""
-        for req in resend:
+        frame gRPC pulled but never delivered is still accounted for.
+        Every yield opens a ``send`` span on the frame's relay timeline
+        (attempt-numbered, replica-labeled)."""
+        for frame in resend:
             if st.gen != gen:
                 return
-            yield req
+            frame.begin_send(endpoint, st.trace_id)
+            yield frame.req
         while True:
             if st.gen != gen or st.closed:
                 return
-            req = None
+            frame = None
             with st.lock:
                 if st.stash:
-                    req = st.stash.popleft()
-            if req is None:
+                    frame = st.stash.popleft()
+            if frame is None:
                 try:
-                    req = st.inbox.get(timeout=_FEED_POLL_S)
+                    frame = st.inbox.get(timeout=_FEED_POLL_S)
                 except queue.Empty:
                     if st.client_done and st.inbox.empty():
                         with st.lock:
@@ -184,11 +425,12 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
                 # pulled after this attempt retired: hand the frame to the
                 # next attempt instead of dropping it
                 with st.lock:
-                    st.stash.append(req)
+                    st.stash.append(frame)
                 return
+            frame.begin_send(endpoint, st.trace_id)
             with st.lock:
-                st.pending.append(req)
-            yield req
+                st.pending.append(frame)
+            yield frame.req
 
     @staticmethod
     def _forwarded_metadata(context) -> tuple:
@@ -211,7 +453,14 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
 
     def AnalyzeActuatorPerformance(self, request_iterator, context):
         router = self.router
-        st = _StreamState()
+        # the stream's trace: the client's traceparent when sent, a
+        # front-end-minted context otherwise -- forwarded to the replica
+        # on EVERY attempt, so a failed-over frame keeps one trace ID
+        # end to end and the replicas' dispatch timelines join the
+        # front-end's relay timelines under it
+        remote = trace.from_metadata(context.invocation_metadata())
+        stream_ctx = trace.new_context(remote)
+        st = _StreamState(trace_id=stream_ctx.trace_id)
         replica = router.pick()
         if replica is None:
             context.abort(
@@ -224,6 +473,8 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
         )
         pump.start()
         metadata = self._forwarded_metadata(context)
+        if not any(k.lower() == trace.TRACEPARENT for k, _ in metadata):
+            metadata = metadata + trace.to_metadata(stream_ctx)
         failovers = 0
         try:
             while True:
@@ -232,14 +483,19 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
                     resend = list(st.pending)
                 try:
                     call = replica.stub.AnalyzeActuatorPerformance(
-                        self._feed(st, st.gen, resend),
+                        self._feed(st, st.gen, resend, replica.endpoint),
                         timeout=self._time_remaining(context),
                         metadata=metadata,
                     )
                     for resp in call:
+                        frame = None
                         with st.lock:
                             if st.pending:
-                                st.pending.popleft()
+                                frame = st.pending.popleft()
+                        if frame is not None:
+                            # answer delivered: the relay timeline closes
+                            # and enters the front-end's /debug/spans ring
+                            frame.finish(self.recorder)
                         # under the router lock: concurrent streams share
                         # this replica, and a bare += here drops counts
                         router.count_frame(replica)
@@ -274,6 +530,25 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
                             next_replica.endpoint, failovers,
                             self.cfg.fleet_max_failovers,
                         )
+                        # each stranded frame's timeline records the hop
+                        # (its re-send opens a fresh attempt-numbered
+                        # send span on the new replica)
+                        with st.lock:
+                            stranded = list(st.pending)
+                        for frame in stranded:
+                            frame.mark_failover(
+                                replica.endpoint, next_replica.endpoint,
+                                st.trace_id, f"replica died ({code})")
+                        self._record_hop(
+                            st, replica.endpoint, next_replica.endpoint,
+                            n_pending, f"replica died ({code})")
+                        journal_lib.JOURNAL.append(
+                            "fleet.failover", trace_id=st.trace_id,
+                            frm=replica.endpoint,
+                            to=next_replica.endpoint,
+                            outcome="rerouted", frames=n_pending,
+                            code=str(code),
+                        )
                         router.record_failover(rerouted=n_pending)
                         router.release(replica)
                         replica = next_replica
@@ -285,6 +560,15 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
                         "fleet: replica %s died (%s) with no failover "
                         "target; error-completing %d in-flight frame(s)",
                         replica.endpoint, code, n_pending,
+                    )
+                    self._record_hop(
+                        st, replica.endpoint, "", n_pending,
+                        f"replica died ({code}); no failover target")
+                    journal_lib.JOURNAL.append(
+                        "fleet.failover", trace_id=st.trace_id,
+                        frm=replica.endpoint, to="",
+                        outcome="error_completed", frames=n_pending,
+                        code=str(code),
                     )
                     router.record_failover(error_completed=n_pending)
                     yield from self._error_complete(
@@ -304,18 +588,35 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
             if replica is not None:
                 router.release(replica)
 
-    @staticmethod
-    def _error_complete(st: _StreamState, replica, why: str):
+    def _record_hop(self, st: _StreamState, frm: str, to: str,
+                    frames: int, why: str) -> None:
+        """Pin a stream-level failover timeline: even when the transport
+        died BETWEEN frames (nothing stranded, nothing re-sent), the
+        stitched /debug/trace must show the hop."""
+        tl = recorder_lib.Timeline(
+            "fleet.failover", labels={"frm": frm, "to": to or "-"})
+        now = time.monotonic_ns()
+        tl.span("failover", start_ns=now, end_ns=now,
+                trace_id=st.trace_id, frm=frm, to=to, frames=frames,
+                reason=why)
+        self.recorder.pin(self.recorder.record(tl))
+
+    def _error_complete(self, st: _StreamState, replica, why: str):
         """Yield one ERROR-status response per pending frame (in order),
         clearing the pending window -- the fleet-level analogue of the
-        replica server's keep-the-stream-alive per-frame errors."""
+        replica server's keep-the-stream-alive per-frame errors. Each
+        frame's relay timeline records errored (and therefore pins)."""
         with st.lock:
             stranded = list(st.pending)
             st.pending.clear()
-        for _ in stranded:
+        for frame in stranded:
+            frame.finish(self.recorder,
+                         error=f"ReplicaUnavailable: {replica.endpoint}: "
+                               f"{why}")
             yield vision_pb2.AnalysisResponse(
                 status=f"ERROR: ReplicaUnavailable: {replica.endpoint}: "
-                       f"{why}; frame error-completed by fleet front-end",
+                       f"{why}; frame error-completed by fleet front-end "
+                       f"[trace={st.trace_id or '-'}]",
             )
 
     # -- lifecycle -----------------------------------------------------------
@@ -329,6 +630,7 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
             except Exception:  # pragma: no cover - teardown best-effort
                 log.exception("rollout manager stop failed")
             self.rollout = None
+        self.federator.stop()
         self.router.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
@@ -364,6 +666,9 @@ def build_frontend(
         breaker_reset_s=cfg.fleet_breaker_reset_s,
         controller=controller,
     )
+    # this process is the fleet's front-end: spans and journal events it
+    # records are attributed to that role in merged multi-process output
+    trace.set_identity(role="frontend")
     frontend = FleetFrontend(router, cfg)
     router.start()  # includes one immediate membership tick
     server = grpc.server(
@@ -376,6 +681,14 @@ def build_frontend(
     frontend.metrics_server = exposition.maybe_start_metrics_server(
         cfg.metrics_port
     )
+    if frontend.metrics_server is not None:
+        # the fleet-only surfaces ride the front-end's metrics port:
+        # /debug/trace (cross-host stitch), /federate (one Prometheus
+        # target for the fleet), and the federator's warm cache
+        frontend.metrics_server.set_trace_provider(frontend.trace_debug)
+        frontend.metrics_server.set_federation_provider(
+            frontend.federator.render)
+        frontend.federator.start()
     log.info("fleet front-end over %d replica(s): %s",
              len(endpoints), ", ".join(endpoints))
     return server, frontend

@@ -72,6 +72,7 @@ import numpy as np
 
 from robotic_discovery_platform_tpu.observability import (
     instruments as obs,
+    journal as journal_lib,
     recorder as recorder_lib,
 )
 from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
@@ -475,6 +476,10 @@ class DecodePool:
                     "watchdog_restart", stage="ingest",
                     error=f"{len(dead)} decode worker(s) died; "
                           f"{len(self._pending)} pending frame(s) failed",
+                )
+                journal_lib.JOURNAL.append(
+                    "watchdog.restart", stage="ingest",
+                    workers=len(dead), pending=len(self._pending),
                 )
                 log.error(
                     "%d decode worker(s) died unexpectedly; failing %d "

@@ -194,6 +194,7 @@ def spawn_local_replicas(
     warmup: tuple[int, int] | None = None,
     force_cpu: int = 1,
     per_replica_env: dict[int, dict] | None = None,
+    metrics_port: int = 0,
     timeout_s: float = _SPAWN_TIMEOUT_S,
 ) -> list[LocalReplica]:
     """Boot ``n`` replica subprocesses against one shared registry and
@@ -201,7 +202,9 @@ def spawn_local_replicas(
     :func:`wait_serving` to additionally wait for health SERVING).
     ``per_replica_env`` overlays extra env vars onto single replicas --
     how the CI fault leg arms ``RDP_FAULTS`` on exactly one fleet member
-    without touching the others."""
+    without touching the others. ``metrics_port=-1`` gives each replica
+    an ephemeral metrics endpoint (advertised back over the stats RPC),
+    which the front-end's federation + trace stitching scrape."""
     replicas: list[LocalReplica] = []
     try:
         for i in range(n):
@@ -220,6 +223,8 @@ def spawn_local_replicas(
                 "--slo-ms", str(slo_ms),
                 "--port", "0",
             ]
+            if metrics_port:
+                argv += ["--metrics-port", str(metrics_port)]
             if force_cpu:
                 argv += ["--force-cpu", str(force_cpu)]
             if warmup is not None:

@@ -69,6 +69,7 @@ from typing import Callable, NamedTuple, Sequence
 from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
 from robotic_discovery_platform_tpu.observability import (
     instruments as obs,
+    journal as journal_lib,
     recorder as recorder_lib,
 )
 from robotic_discovery_platform_tpu.utils.config import (
@@ -567,6 +568,10 @@ class RolloutManager:
             "serving.rollout.transition", frm=frm, to=to,
             **{k: str(v) for k, v in labels.items()},
         ))
+        journal_lib.JOURNAL.append(
+            "rollout.transition", frm=frm, to=to,
+            **{k: str(v) for k, v in labels.items()},
+        )
         log.info("rollout: %s -> %s%s", frm, to,
                  f" {labels}" if labels else "")
 

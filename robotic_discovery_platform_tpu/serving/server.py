@@ -126,6 +126,7 @@ from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
 from robotic_discovery_platform_tpu.observability import (
     exposition,
     instruments as obs,
+    journal as journal_lib,
     recorder as recorder_lib,
     slo as slo_lib,
     trace,
@@ -619,6 +620,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             reference=rec.reference_source,
             reason=rec.reason,
         ))
+        journal_lib.JOURNAL.append(
+            "drift.recommendation", rec.reason, model=model,
+            signals=",".join(rec.signals), generation=str(rec.generation),
+        )
         log.warning("DRIFT[%s]: %s -- recommend retraining", model,
                     rec.reason)
 
@@ -638,6 +643,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             reference=rec.reference_source,
             reason=rec.reason,
         ))
+        journal_lib.JOURNAL.append(
+            "drift.recommendation", rec.reason,
+            signals=",".join(rec.signals), generation=str(rec.generation),
+        )
         log.warning(
             "DRIFT: %s -- recommend retraining (workflows.retraining)",
             rec.reason,
@@ -1288,6 +1297,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # (read under the reload lock): a scrape racing a promotion sees
         # either the old pair or the new pair, never a mix
         version, drift_generation = self.version_and_reference()
+        host, role = trace.identity()
         with self._streams_cond:
             model_frames = dict(self._model_frames)
         # per-model demand next to the aggregate: the capacity planner's
@@ -1315,6 +1325,14 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             "draining": self.is_draining,
             "refusing_streams": self._refusing_streams,
             "pid": os.getpid(),
+            # observability-plane discovery: the fleet front-end scrapes
+            # this replica's /metrics + /debug/spans for federation and
+            # cross-host trace stitching at the advertised port (0 = no
+            # metrics endpoint), attributing them to host/role identity
+            "metrics_port": (self.metrics_server.port
+                             if self.metrics_server is not None else 0),
+            "host": host,
+            "role": role,
         }
 
     def AnalyzeActuatorPerformance(self, request_iterator, context):
@@ -1838,6 +1856,8 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
     def mark_ready(self) -> None:
         self.health.set_all(health_lib.SERVING)
+        journal_lib.JOURNAL.append(
+            "server.ready", version=str(self.current_version))
 
     def drain(self, timeout_s: float | None = None) -> bool:
         """Begin graceful shutdown: flip readiness to NOT_SERVING, refuse
@@ -1851,6 +1871,8 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             self._draining = True
         if not already:
             self.health.set_all(health_lib.NOT_SERVING)
+            journal_lib.JOURNAL.append(
+                "server.drain", streams=str(self.active_streams))
             log.info("draining: readiness down, waiting for %d in-flight "
                      "stream(s)", self.active_streams)
         deadline = time.monotonic() + timeout_s
@@ -1917,6 +1939,10 @@ def build_server(
     override (e.g. stride=1 for reference-exact dense semantics)."""
     if geom_cfg is None:
         geom_cfg = GeometryConfig(stride=cfg.geometry_stride)
+    # this process serves frames: spans and journal events it records are
+    # attributed to the replica role in merged multi-process output (the
+    # front-end's stitched /debug/trace and federated journal reads)
+    trace.set_identity(role="replica")
     model, variables, version = resolve_serving_model(cfg)
     intrinsics = None
     depth_scale = cfg.default_depth_scale

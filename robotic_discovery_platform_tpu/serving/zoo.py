@@ -45,7 +45,10 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from robotic_discovery_platform_tpu.models import variants as variants_lib
-from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.observability import (
+    instruments as obs,
+    journal as journal_lib,
+)
 from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
@@ -369,6 +372,12 @@ class ZooPlacer:
                 n = self.rebalances
         if changed:
             obs.ZOO_REBALANCES.inc()
+            journal_lib.JOURNAL.append(
+                "zoo.rebalance", rebalance=n,
+                placement=";".join(
+                    f"{m}:{','.join(map(str, cs))}"
+                    for m, cs in sorted(placement.items())),
+            )
             log.info("zoo placement #%d: %s", n,
                      {m: list(cs) for m, cs in placement.items()})
         self._publish(placement)

@@ -1,0 +1,544 @@
+"""Fleet observability plane tests (PR 15).
+
+Four layers, cheapest first:
+
+- journal units: monotonic cursor, bounded ring + dropped accounting,
+  trace-ID stamping, enable gate, snapshot shape;
+- federation units: exposition re-labeling (escapes, histograms, header
+  dedupe), up/staleness markers over injected fetchers, fleet roll-ups
+  from stats payloads -- no sockets;
+- exposition endpoints: /debug/events, /debug/trace, /federate wiring
+  (and the grown 404 help text);
+- relay tracing: a fleet front-end over fake echo replicas proves a
+  failed-over frame carries the client's ORIGINAL traceparent to the new
+  replica and records the failover hop on its relay timeline, and a real
+  1-replica in-process fleet proves the stitched /debug/trace merges
+  front-end relay timelines with the replica's dispatch timelines.
+"""
+
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent import futures
+
+import grpc
+import pytest
+
+from robotic_discovery_platform_tpu.observability import (
+    exposition,
+    federation as federation_lib,
+    journal as journal_lib,
+    recorder as recorder_lib,
+    trace,
+)
+from robotic_discovery_platform_tpu.serving import (
+    fleet as fleet_lib,
+    frontend as frontend_lib,
+    health as health_lib,
+)
+from robotic_discovery_platform_tpu.serving.proto import (
+    vision_grpc,
+    vision_pb2,
+)
+from robotic_discovery_platform_tpu.utils.config import ServerConfig
+
+
+@pytest.fixture()
+def restore_identity():
+    host, role = trace.identity()
+    yield
+    trace.set_identity(host=host, role=role)
+
+
+# -- journal units -----------------------------------------------------------
+
+
+def test_journal_cursor_is_monotonic_and_causal():
+    j = journal_lib.EventJournal(capacity=16)
+    events = [j.append(f"kind.{i}") for i in range(5)]
+    assert [e.seq for e in events] == [0, 1, 2, 3, 4]
+    got = j.events_since(0)
+    assert [e.kind for e in got] == [f"kind.{i}" for i in range(5)]
+    assert [e.kind for e in j.events_since(3)] == ["kind.3", "kind.4"]
+
+
+def test_journal_bounded_with_dropped_accounting():
+    j = journal_lib.EventJournal(capacity=4)
+    for i in range(10):
+        j.append("k", i=i)
+    snap = j.snapshot(since=0)
+    assert len(snap["events"]) == 4
+    assert snap["events"][0]["seq"] == 6
+    assert snap["dropped"] == 6  # seqs 0..5 evicted before the reader
+    assert snap["next_cursor"] == 10
+    # a caught-up reader has no gap
+    assert j.snapshot(since=8)["dropped"] == 0
+
+
+def test_journal_stamps_trace_id_and_identity(restore_identity):
+    trace.set_identity(host="h:1", role="replica")
+    j = journal_lib.EventJournal(capacity=8)
+    outside = j.append("no.trace")
+    assert outside.trace_id is None
+    with trace.span("unit") as sp:
+        inside = j.append("with.trace", chip=3)
+    assert inside.trace_id == sp.trace_id
+    assert inside.host == "h:1" and inside.role == "replica"
+    assert inside.attrs == {"chip": "3"}
+
+
+def test_journal_enable_gate():
+    j = journal_lib.EventJournal(capacity=8)
+    j.append("before")
+    j.set_enabled(False)
+    assert j.append("while.off") is None
+    j.set_enabled(True)
+    j.append("after")
+    assert [e.kind for e in j.events_since(0)] == ["before", "after"]
+
+
+def test_journal_concurrent_appends_keep_unique_ordered_seqs():
+    j = journal_lib.EventJournal(capacity=4096)
+    n, workers = 200, 8
+
+    def spin(w):
+        for i in range(n):
+            j.append("k", w=w, i=i)
+
+    threads = [threading.Thread(target=spin, args=(w,))
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    seqs = [e.seq for e in j.events_since(0)]
+    assert len(seqs) == n * workers
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+# -- span identity -----------------------------------------------------------
+
+
+def test_span_records_carry_host_and_role(restore_identity):
+    trace.set_identity(host="box:7", role="frontend")
+    rec = trace.SpanRecord(name="x")
+    d = rec.to_dict()
+    assert d["host"] == "box:7" and d["role"] == "frontend"
+
+
+def test_recorder_snapshot_and_tracez_group_by_identity(restore_identity):
+    trace.set_identity(host="box:9", role="replica")
+    rec = recorder_lib.FlightRecorder(capacity=8)
+    tl = recorder_lib.Timeline("dispatch")
+    root = tl.span("dispatch", start_ns=0, end_ns=2_000_000)
+    tl.span("stage", start_ns=0, end_ns=1_000_000, parent=root)
+    rec.record(tl)
+    snap = rec.snapshot()
+    assert snap["host"] == "box:9" and snap["role"] == "replica"
+    assert all(s["host"] == "box:9" and s["role"] == "replica"
+               for s in snap["recent"][0]["spans"])
+    summ = rec.summary()
+    assert summ["spans"]["dispatch"]["count"] == 1  # legacy aggregate
+    assert summ["groups"]["replica@box:9"]["spans"]["stage"]["count"] == 1
+
+
+# -- federation units --------------------------------------------------------
+
+_REPLICA_TEXT = """\
+# HELP rdp_frames_total Frames handled.
+# TYPE rdp_frames_total counter
+rdp_frames_total{status="ok",model="seg"} 12
+rdp_frames_total{status="err\\"or",model="seg"} 1
+# HELP rdp_lat_seconds Latency.
+# TYPE rdp_lat_seconds histogram
+rdp_lat_seconds_bucket{le="0.1"} 3
+rdp_lat_seconds_bucket{le="+Inf"} 4
+rdp_lat_seconds_sum 0.5
+rdp_lat_seconds_count 4
+# HELP rdp_up Up.
+# TYPE rdp_up gauge
+rdp_up 1
+"""
+
+
+def test_relabel_injects_replica_label_first():
+    fams = federation_lib.relabel(_REPLICA_TEXT, "replica", "host:1")
+    text = federation_lib.merge_exposition(fams)
+    assert ('rdp_frames_total{replica="host:1",status="ok",model="seg"} 12'
+            in text)
+    # escaped quote in an original label value survives the splice
+    assert 'status="err\\"or"' in text
+    # unlabeled samples (incl. histogram _sum/_count) gain the label
+    assert 'rdp_lat_seconds_sum{replica="host:1"} 0.5' in text
+    assert 'rdp_lat_seconds_bucket{replica="host:1",le="+Inf"} 4' in text
+    assert 'rdp_up{replica="host:1"} 1' in text
+    # one header per family even after merging a second source
+    federation_lib.relabel(_REPLICA_TEXT, "replica", "host:2", fams)
+    text = federation_lib.merge_exposition(fams)
+    assert text.count("# TYPE rdp_frames_total counter") == 1
+    assert 'rdp_frames_total{replica="host:2",status="ok",model="seg"} 12' \
+        in text
+
+
+def _targets(*specs):
+    return [federation_lib.ScrapeTarget(replica=ep, base_url=url,
+                                        stats=stats)
+            for ep, url, stats in specs]
+
+
+def test_federator_marks_up_and_serves_stale_cache():
+    calls = {"fail": False}
+
+    def fetch(url, timeout_s):
+        if calls["fail"] and "r1" in url:
+            raise OSError("connection refused")
+        if url.endswith("/metrics"):
+            return _REPLICA_TEXT
+        return json.dumps({"host": "h", "role": "replica",
+                           "recent": [], "pinned": []})
+
+    targets = _targets(
+        ("r1:9", "http://r1:9464", {"burn": 1.0, "frames_total": 10,
+                                    "models": {"seg": {"rate": 2.0}}}),
+        ("r2:9", "http://r2:9464", {"burn": 0.5, "frames_total": 30,
+                                    "models": {"seg": {"rate": 1.0},
+                                               "aux": {"rate": 4.0}}}),
+    )
+    fed = federation_lib.FleetFederator(lambda: targets, fetch=fetch)
+    text = fed.render()
+    assert 'rdp_replica_up{replica="r1:9"} 1' in text
+    assert 'rdp_replica_up{replica="r2:9"} 1' in text
+    assert 'rdp_frames_total{replica="r1:9",status="ok",model="seg"} 12' \
+        in text
+    # roll-ups from the stats payloads
+    assert "rdp_fleet_frames 40" in text
+    assert 'rdp_fleet_burn{stat="max"} 1' in text
+    assert 'rdp_fleet_model_arrival_rate{model="seg"} 3' in text
+    assert 'rdp_fleet_model_arrival_rate{model="aux"} 4' in text
+
+    # r1 dies: marked down, its LAST GOOD families still served, and the
+    # survivor's samples are untouched
+    calls["fail"] = True
+    text = fed.render()
+    assert 'rdp_replica_up{replica="r1:9"} 0' in text
+    assert 'rdp_replica_up{replica="r2:9"} 1' in text
+    assert 'rdp_frames_total{replica="r1:9",status="ok",model="seg"} 12' \
+        in text
+    assert 'rdp_frames_total{replica="r2:9",status="ok",model="seg"} 12' \
+        in text
+    payloads = {t.replica: (p, fresh)
+                for t, p, _age, fresh in fed.span_payloads()}
+    assert payloads["r1:9"][1] is False  # stale cache
+    assert payloads["r1:9"][0] is not None
+    assert payloads["r2:9"][1] is True
+
+
+def test_federator_never_scraped_target_is_down_without_samples():
+    def fetch(url, timeout_s):
+        raise OSError("refused")
+
+    fed = federation_lib.FleetFederator(
+        lambda: _targets(("dead:1", "http://dead:1", {})), fetch=fetch)
+    text = fed.render()
+    assert 'rdp_replica_up{replica="dead:1"} 0' in text
+    assert 'rdp_replica_scrape_age_seconds{replica="dead:1"} -1' in text
+
+
+# -- exposition endpoints ----------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_debug_events_endpoint_and_404_enumerates_surface():
+    j = journal_lib.EventJournal(capacity=8)
+    j.append("unit.event", detail="x")
+    srv = exposition.MetricsServer(0, journal=j).start()
+    try:
+        _, body = _get(srv.port, "/debug/events?since=0")
+        payload = json.loads(body)
+        assert payload["events"][0]["kind"] == "unit.event"
+        assert payload["next_cursor"] == 1
+        # bad cursor -> 400
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.port, "/debug/events?since=nope")
+        assert err.value.code == 400
+        # the 404 help text enumerates the full debug surface
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.port, "/nope")
+        help_text = err.value.read().decode()
+        for endpoint in ("/metrics", "/federate", "/debug/spans",
+                         "/debug/tracez", "/debug/trace?id=",
+                         "/debug/events?since=", "/debug/drift",
+                         "/debug/rollout", "/debug/zoo",
+                         "/debug/profile?seconds="):
+            assert endpoint.rstrip("=") in help_text, endpoint
+        # fleet-only surfaces 404 on a plain replica
+        for path in ("/debug/trace?id=" + "0" * 32, "/federate"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv.port, path)
+            assert err.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_trace_and_federation_providers_serve():
+    srv = exposition.MetricsServer(0)
+    srv.set_trace_provider(lambda tid: {"trace_id": tid, "sources": []})
+    srv.set_federation_provider(lambda: "rdp_replica_up 1\n")
+    srv.start()
+    try:
+        _, body = _get(srv.port, "/debug/trace?id=" + "ab" * 16)
+        assert json.loads(body)["trace_id"] == "ab" * 16
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.port, "/debug/trace")
+        assert err.value.code == 400  # id is required
+        _, body = _get(srv.port, "/federate")
+        assert body == "rdp_replica_up 1\n"
+    finally:
+        srv.stop()
+
+
+# -- relay tracing over fake replicas ----------------------------------------
+
+
+class _EchoVision(vision_grpc.VisionAnalysisServiceServicer):
+    """Fake replica: echoes one OK response per request, records each
+    stream's forwarded traceparent, and can be armed to die mid-stream
+    (the failover trigger)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.traceparents = []
+        self.frames = 0
+        self.die_after: int | None = None
+
+    def AnalyzeActuatorPerformance(self, request_iterator, context):
+        md = {k.lower(): v for k, v in context.invocation_metadata()}
+        self.traceparents.append(md.get(trace.TRACEPARENT))
+        for i, _req in enumerate(request_iterator):
+            if self.die_after is not None and i >= self.die_after:
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              "injected replica death")
+            self.frames += 1
+            yield vision_pb2.AnalysisResponse(status=f"OK: {self.name}")
+
+
+def _boot_fake_replica(name):
+    servicer = _EchoVision(name)
+    health = health_lib.HealthServicer()
+    health.set("", health_lib.SERVING)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    vision_grpc.add_VisionAnalysisServiceServicer_to_server(
+        servicer, server)
+    health_lib.add_HealthServicer_to_server(health, server)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    return server, servicer, f"localhost:{port}"
+
+
+def test_failover_resend_carries_original_traceparent_end_to_end():
+    """Satellite: a rerouted frame keeps ONE trace ID -- the client's
+    original traceparent reaches the failover replica verbatim, and the
+    front-end's relay timeline records the hop."""
+    s1, fake1, ep1 = _boot_fake_replica("r1")
+    s2, fake2, ep2 = _boot_fake_replica("r2")
+    rec = recorder_lib.FlightRecorder(capacity=32)
+    jl = journal_lib.JOURNAL
+    cursor = jl.snapshot()["next_cursor"]
+    cfg = ServerConfig(
+        address="localhost:0",
+        fleet_replicas=f"{ep1},{ep2}",
+        fleet_poll_s=0.1,
+        fleet_breaker_failures=1,
+        fleet_breaker_reset_s=30.0,
+    )
+    router = fleet_lib.FleetRouter(
+        [ep1, ep2], poll_s=0.1, breaker_failures=1, breaker_reset_s=30.0)
+    fe = frontend_lib.FleetFrontend(router, cfg, flight_recorder=rec)
+    router.start()
+    f_server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    vision_grpc.add_VisionAnalysisServiceServicer_to_server(fe, f_server)
+    f_port = f_server.add_insecure_port("localhost:0")
+    f_server.start()
+    channel = grpc.insecure_channel(f"localhost:{f_port}")
+    try:
+        assert router.wait_live(2, timeout_s=10)
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        client_ctx = trace.new_context()
+        outbox: queue.Queue = queue.Queue()
+
+        def gen():
+            while True:
+                item = outbox.get()
+                if item is None:
+                    return
+                yield item
+
+        responses = stub.AnalyzeActuatorPerformance(
+            gen(), metadata=trace.to_metadata(client_ctx))
+        outbox.put(vision_pb2.AnalysisRequest())
+        r0 = next(responses)
+        assert r0.status.startswith("OK")
+        first = fake1 if fake1.frames else fake2
+        second = fake2 if first is fake1 else fake1
+
+        # arm the placed replica to die on its NEXT frame; the pending
+        # frame must fail over to the other one
+        first.die_after = 0
+        outbox.put(vision_pb2.AnalysisRequest())
+        r1 = next(responses)
+        assert r1.status.startswith("OK")
+        assert second.frames >= 1
+        outbox.put(None)
+        assert list(responses) == []
+
+        # ONE trace ID end to end: both replicas saw the client's trace
+        for tp in (*first.traceparents, *second.traceparents):
+            assert tp is not None
+            parsed = trace.parse_traceparent(tp)
+            assert parsed is not None
+            assert parsed.trace_id == client_ctx.trace_id
+
+        # the rerouted frame's relay timeline shows the hop: two
+        # attempt-numbered send spans around a failover span
+        relays = [t for t in rec.timelines() if t.name == "relay"]
+        assert relays, "no relay timelines recorded"
+        assert all(
+            s.trace_id == client_ctx.trace_id
+            for t in relays for s in t.spans
+        )
+        hop = [t for t in relays
+               if any(s.name == "failover" for s in t.spans)]
+        assert len(hop) == 1
+        sends = [s for s in hop[0].spans if s.name == "send"]
+        assert [s.attributes["attempt"] for s in sends] == ["1", "2"]
+        assert sends[0].attributes["replica"] != sends[1].attributes[
+            "replica"]
+
+        # journal: breaker open (quarantine) then the failover, in
+        # causal order, the failover stamped with the stream's trace
+        events = [e for e in jl.events_since(cursor)
+                  if e.kind in ("breaker.transition", "fleet.failover")]
+        kinds = [e.kind for e in events]
+        assert "fleet.failover" in kinds
+        opened = [e for e in events if e.kind == "breaker.transition"
+                  and e.attrs.get("to") == "open"]
+        assert opened
+        failover = next(e for e in events if e.kind == "fleet.failover")
+        assert failover.seq > opened[0].seq
+        assert failover.trace_id == client_ctx.trace_id
+        assert failover.attrs["outcome"] == "rerouted"
+    finally:
+        channel.close()
+        f_server.stop(grace=None)
+        fe.close()
+        s1.stop(grace=None)
+        s2.stop(grace=None)
+
+
+def test_trace_debug_stitches_frontend_and_replica_sources():
+    """The /debug/trace stitcher merges the front-end's own relay
+    timelines with per-replica /debug/spans payloads (fed through the
+    federator's injected fetcher) into one tree keyed by trace ID."""
+    tid = "ab" * 16
+    rec = recorder_lib.FlightRecorder(capacity=8)
+    tl = recorder_lib.Timeline("relay")
+    root = tl.span("relay", start_ns=0, end_ns=5_000_000, trace_id=tid)
+    tl.span("send", start_ns=1, end_ns=4_000_000, parent=root,
+            trace_id=tid, replica="r1:9")
+    rec.record(tl)
+
+    replica_payload = {
+        "host": "rhost:42", "role": "replica",
+        "recent": [{
+            "name": "dispatch", "seq": 0, "labels": {"chip": "0"},
+            "error": None, "created_unix_s": 1.0, "duration_ms": 2.0,
+            "spans": [
+                {"name": "dispatch", "span_id": "d1", "parent_id": None,
+                 "trace_id": None, "start_ns": 0, "end_ns": 10,
+                 "attributes": {}, "host": "rhost:42",
+                 "role": "replica"},
+                {"name": "submit", "span_id": "s1", "parent_id": "d1",
+                 "trace_id": tid, "start_ns": 0, "end_ns": 5,
+                 "attributes": {}, "host": "rhost:42",
+                 "role": "replica"},
+            ],
+        }],
+        "pinned": [],
+    }
+
+    def fetch(url, timeout_s):
+        if url.endswith("/metrics"):
+            return "rdp_up 1\n"
+        return json.dumps(replica_payload)
+
+    router = fleet_lib.FleetRouter(["r1:9"], poll_s=30.0)
+    router.replicas[0].metrics_port = 9464
+    fe = frontend_lib.FleetFrontend(router, ServerConfig(
+        fleet_replicas="r1:9"), flight_recorder=rec)
+    fe.federator._fetch = fetch
+    try:
+        out = fe.trace_debug(tid)
+        assert out["trace_id"] == tid
+        assert out["timelines_total"] == 2
+        roles = {s["role"] for s in out["sources"] if s["timelines"]}
+        assert roles == {"frontend", "replica"}
+        tree = out["tree"]
+        assert {c["role"] for c in tree["children"]} == {"frontend",
+                                                         "replica"}
+        replica_child = next(c for c in tree["children"]
+                             if c["role"] == "replica")
+        assert replica_child["host"] == "rhost:42"
+        # spans nest by parent link inside the stitched tree
+        dispatch = replica_child["timelines"][0]["spans"][0]
+        assert dispatch["name"] == "dispatch"
+        assert dispatch["children"][0]["name"] == "submit"
+        # malformed IDs are rejected, not crashed on
+        assert "error" in fe.trace_debug("not-a-trace")
+    finally:
+        fe.close()
+
+
+def test_relay_timelines_record_for_clients_without_traceparent():
+    """A traceparent-less client still gets a coherent trace: the
+    front-end mints one, forwards it, and its relay timelines carry it."""
+    s1, fake1, ep1 = _boot_fake_replica("r1")
+    rec = recorder_lib.FlightRecorder(capacity=8)
+    router = fleet_lib.FleetRouter([ep1], poll_s=0.1)
+    fe = frontend_lib.FleetFrontend(router, ServerConfig(
+        fleet_replicas=ep1), flight_recorder=rec)
+    router.start()
+    f_server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    vision_grpc.add_VisionAnalysisServiceServicer_to_server(fe, f_server)
+    f_port = f_server.add_insecure_port("localhost:0")
+    f_server.start()
+    channel = grpc.insecure_channel(f"localhost:{f_port}")
+    try:
+        assert router.wait_live(1, timeout_s=10)
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        resps = list(stub.AnalyzeActuatorPerformance(
+            iter([vision_pb2.AnalysisRequest()])))
+        assert len(resps) == 1 and resps[0].status.startswith("OK")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not rec.timelines():
+            time.sleep(0.01)
+        relays = [t for t in rec.timelines() if t.name == "relay"]
+        assert relays
+        minted = relays[0].spans[0].trace_id
+        assert minted is not None and len(minted) == 32
+        # the replica received the SAME minted trace
+        assert fake1.traceparents
+        assert trace.parse_traceparent(
+            fake1.traceparents[0]).trace_id == minted
+    finally:
+        channel.close()
+        f_server.stop(grace=None)
+        fe.close()
+        s1.stop(grace=None)

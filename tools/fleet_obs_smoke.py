@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""CI fleet-observability smoke: one query tells the story.
+
+Boots a 2-replica local CPU fleet (subprocess replicas with ephemeral
+metrics endpoints) behind the in-process front-end, streams frames under
+one client trace, SIGKILLs the replica the stream is placed on
+mid-stream, and asserts the whole observability plane from the
+front-end's single port:
+
+- ``GET /debug/trace?id=<trace_id>`` returns ONE stitched tree holding
+  the front-end's relay timelines (including the failover hop span) AND
+  BOTH replicas' dispatch timelines for the trace -- the dead replica's
+  evidence served from the federator's last-good cache, marked stale;
+- ``GET /federate`` marks the dead replica ``rdp_replica_up 0`` without
+  dropping the survivor's samples (and keeps the victim's last families
+  with a staleness age);
+- ``GET /debug/events?since=0`` holds the quarantine (breaker open),
+  failover, and -- after the victim respawns on its old port -- rejoin
+  events in causal (cursor) order.
+
+Run under both strict sanitizers:
+``env JAX_PLATFORMS=cpu RDP_LOCKCHECK=strict RDP_TRANSFER_GUARD=strict
+python tools/fleet_obs_smoke.py``. Exit 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def _get(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.read().decode()
+
+
+def _fail(msg: str, extra=None) -> int:
+    print(f"FAIL: {msg}")
+    if extra is not None:
+        print(json.dumps(extra, indent=1, default=str)[:4000])
+    return 1
+
+
+def main() -> int:
+    import os
+
+    # this process IS the front-end; an inherited fixed metrics port
+    # would collide with the replicas' resolution of the same env var
+    os.environ.pop("RDP_METRICS_PORT", None)
+
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        force_cpu_platform,
+    )
+
+    force_cpu_platform(min_devices=1)
+
+    import grpc
+
+    from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+    from robotic_discovery_platform_tpu.observability import (
+        journal as journal_lib,
+        trace,
+    )
+    from robotic_discovery_platform_tpu.serving import (
+        client as client_lib,
+        frontend as frontend_lib,
+        replica as replica_lib,
+    )
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+    from robotic_discovery_platform_tpu.utils.config import ServerConfig
+
+    tmp = Path(tempfile.mkdtemp(prefix="rdp-fleet-obs-"))
+    uri = replica_lib.register_tiny_model(tmp / "mlruns", img_size=64)
+    replicas = replica_lib.spawn_local_replicas(
+        2, uri, img_size=64, slo_ms=250.0, metrics_port=-1)
+    endpoints = [r.endpoint for r in replicas]
+    f_server = fe = channel = None
+    rc = 1
+    try:
+        replica_lib.wait_serving(endpoints)
+        fcfg = ServerConfig(
+            address="localhost:0",
+            fleet_replicas=",".join(endpoints),
+            fleet_poll_s=0.15,
+            fleet_probe_timeout_s=2.0,
+            fleet_breaker_failures=1,
+            fleet_breaker_reset_s=1.0,
+            metrics_port=-1,  # ephemeral: the fleet's one-stop port
+        )
+        f_server, fe = frontend_lib.build_frontend(fcfg)
+        fport = f_server.add_insecure_port("localhost:0")
+        f_server.start()
+        if fe.metrics_server is None:
+            return _fail("front-end metrics server did not start")
+        mport = fe.metrics_server.port
+        if not fe.router.wait_live(2, timeout_s=60):
+            return _fail("fleet never reached 2 placeable replicas")
+        cursor0 = journal_lib.JOURNAL.snapshot()["next_cursor"]
+
+        # one client trace for the whole stream
+        src = SyntheticSource(width=64, height=48, seed=3, n_frames=1)
+        src.start()
+        color, depth = src.get_frames()
+        src.stop()
+        request = client_lib.encode_request(color, depth)
+        client_ctx = trace.new_context()
+        trace_id = client_ctx.trace_id
+
+        channel = grpc.insecure_channel(f"localhost:{fport}")
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        outbox: queue.Queue = queue.Queue()
+
+        def gen():
+            while True:
+                item = outbox.get()
+                if item is None:
+                    return
+                yield item
+
+        responses = stub.AnalyzeActuatorPerformance(
+            gen(), timeout=120, metadata=trace.to_metadata(client_ctx))
+
+        # a few frames land on the placed replica; the federator cache
+        # (poll thread) picks up its dispatch timelines for this trace
+        for _ in range(3):
+            outbox.put(request)
+            resp = next(responses)
+            if not resp.status.startswith(("OK", "DEGRADED")):
+                return _fail(f"pre-kill frame errored: {resp.status}")
+        placed = [r for r in fe.router.replicas if r.inflight > 0]
+        if len(placed) != 1:
+            return _fail(f"expected 1 placed replica, got {placed}")
+        victim = placed[0]
+        survivor_ep = next(ep for ep in endpoints
+                           if ep != victim.endpoint)
+        deadline = time.monotonic() + 20.0
+        pre = {}
+        while time.monotonic() < deadline:
+            pre = json.loads(
+                _get(mport, f"/debug/trace?id={trace_id}"))
+            victim_src = next(
+                (s for s in pre["sources"]
+                 if s.get("endpoint") == victim.endpoint), {})
+            if victim_src.get("timelines"):
+                break
+            time.sleep(0.2)
+        else:
+            return _fail("victim's dispatch timelines never appeared in "
+                         "the stitched trace pre-kill", pre)
+
+        # SIGKILL the placed replica; the stream's next frame must fail
+        # over to the survivor under the SAME trace
+        victim_local = next(r for r in replicas
+                            if r.endpoint == victim.endpoint)
+        victim_local.kill()
+        outbox.put(request)
+        resp = next(responses)
+        if not resp.status.startswith(("OK", "DEGRADED", "ERROR")):
+            return _fail(f"failed-over frame lost: {resp.status!r}")
+        failed_over_ok = resp.status.startswith(("OK", "DEGRADED"))
+        outbox.put(request)
+        resp2 = next(responses)  # the stream keeps serving post-failover
+        outbox.put(None)
+        leftovers = [r.status for r in responses]
+
+        # -- the stitched trace: one query, whole story ------------------
+        stitched = json.loads(_get(mport, f"/debug/trace?id={trace_id}"))
+        tree = stitched.get("tree", {})
+        by_endpoint = {s.get("endpoint"): s
+                       for s in stitched.get("sources", [])}
+        fe_src = by_endpoint.get(None, {})
+        relay_tls = fe_src.get("timelines", [])
+        if not relay_tls:
+            return _fail("no front-end relay timelines in stitched "
+                         "trace", stitched)
+        hops = [s for tl in relay_tls for s in tl.get("spans", [])
+                if s.get("name") == "failover"]
+        if not hops:
+            return _fail("stitched trace shows no failover hop", stitched)
+        hop = hops[0]
+        if (hop["attributes"].get("frm") != victim.endpoint
+                or hop["attributes"].get("to") != survivor_ep):
+            return _fail(f"failover hop names wrong replicas: "
+                         f"{hop['attributes']}", stitched)
+        for ep in endpoints:
+            src_tls = by_endpoint.get(ep, {}).get("timelines", [])
+            if not src_tls:
+                return _fail(f"replica {ep} has no timelines in the "
+                             "stitched trace", stitched)
+            if by_endpoint[ep].get("role") != "replica":
+                return _fail(f"replica {ep} not attributed role=replica",
+                             by_endpoint[ep])
+        if not by_endpoint[victim.endpoint].get("fresh") is False:
+            return _fail("dead replica's timelines not marked stale",
+                         by_endpoint[victim.endpoint])
+        tree_eps = {c.get("endpoint") for c in tree.get("children", [])}
+        if not {None, victim.endpoint, survivor_ep} <= tree_eps:
+            return _fail(f"stitched tree is missing sources: {tree_eps}")
+
+        # -- the federated scrape ----------------------------------------
+        fed = _get(mport, "/federate")
+        if f'rdp_replica_up{{replica="{victim.endpoint}"}} 0' not in fed:
+            return _fail("dead replica not marked rdp_replica_up 0")
+        if f'rdp_replica_up{{replica="{survivor_ep}"}} 1' not in fed:
+            return _fail("survivor not marked rdp_replica_up 1")
+        survivor_samples = [ln for ln in fed.splitlines()
+                            if f'replica="{survivor_ep}"' in ln]
+        victim_samples = [ln for ln in fed.splitlines()
+                          if f'replica="{victim.endpoint}"' in ln
+                          and ln.startswith("rdp_frames_total")]
+        if not any(ln.startswith("rdp_frames_total")
+                   for ln in survivor_samples):
+            return _fail("survivor's samples missing from /federate")
+        if not victim_samples:
+            return _fail("victim's last-good families dropped from "
+                         "/federate (staleness cache lost)")
+        if "rdp_fleet_frames" not in fed or "rdp_fleet_burn" not in fed:
+            return _fail("fleet roll-up families missing from /federate")
+
+        # -- the journal: quarantine -> failover in causal order ---------
+        events = json.loads(
+            _get(mport, f"/debug/events?since={cursor0}"))["events"]
+        opened = [e for e in events
+                  if e["kind"] == "breaker.transition"
+                  and e["attrs"].get("to") == "open"
+                  and victim.endpoint in e["attrs"].get("breaker", "")]
+        failovers = [e for e in events if e["kind"] == "fleet.failover"]
+        if not opened:
+            return _fail("no quarantine (breaker open) event for the "
+                         "victim", events)
+        if not failovers:
+            return _fail("no fleet.failover event", events)
+        if not opened[0]["seq"] < failovers[0]["seq"]:
+            return _fail("quarantine and failover out of causal order",
+                         events)
+        if failovers[0]["trace_id"] != trace_id:
+            return _fail("failover event not stamped with the stream's "
+                         "trace", failovers[0])
+
+        # -- rejoin: respawn on the old port, half-open probe readmits ---
+        replicas[replicas.index(victim_local)] = (
+            replica_lib.respawn_replica(victim_local))
+        replica_lib.wait_serving([victim.endpoint])
+        if not fe.router.wait_live(2, timeout_s=30):
+            return _fail("victim never rejoined the ring")
+        events = json.loads(
+            _get(mport, f"/debug/events?since={cursor0}"))["events"]
+        rejoins = [e for e in events
+                   if e["kind"] == "fleet.membership"
+                   and e["attrs"].get("replica") == victim.endpoint
+                   and e["attrs"].get("state") == "joined"
+                   and e["seq"] > failovers[0]["seq"]]
+        if not rejoins:
+            return _fail("no rejoin membership event after the failover",
+                         events)
+
+        print("OK: stitched /debug/trace holds frontend relay + both "
+              "replicas' timelines (victim stale-cached), /federate "
+              f"marks up=0/1 correctly, journal order quarantine#"
+              f"{opened[0]['seq']} < failover#{failovers[0]['seq']} < "
+              f"rejoin#{rejoins[0]['seq']}; failed-over frame "
+              f"{'rerouted OK' if failed_over_ok else 'error-completed'},"
+              f" post-failover frame {resp2.status.split(':')[0]!r}, "
+              f"{len(leftovers)} leftover response(s)")
+        rc = 0
+        return rc
+    finally:
+        if channel is not None:
+            channel.close()
+        if f_server is not None:
+            f_server.stop(grace=None)
+        if fe is not None:
+            fe.close()
+        replica_lib.stop_replicas(replicas)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,7 @@ import shutil
 import subprocess
 import sys
 import tempfile
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -50,6 +51,29 @@ REQUIRED_FAMILIES = (
     "rdp_zoo_models",
     "rdp_model_dispatches_total",
     "rdp_model_arrival_rate",
+    # fleet observability plane (PR 15): the journal counts events on
+    # every server; the federation/roll-up families are declared
+    # everywhere and populated on the front-end's /federate renders
+    "rdp_journal_events_total",
+    "rdp_journal_dropped_total",
+    "rdp_replica_up",
+    "rdp_replica_scrape_age_seconds",
+    "rdp_fleet_burn",
+    "rdp_fleet_frames",
+    "rdp_fleet_model_arrival_rate",
+)
+#: every /debug endpoint the 404 help text must enumerate
+DEBUG_ENDPOINTS = (
+    "/metrics",
+    "/federate",
+    "/debug/spans",
+    "/debug/tracez",
+    "/debug/trace",
+    "/debug/events",
+    "/debug/drift",
+    "/debug/rollout",
+    "/debug/zoo",
+    "/debug/profile",
 )
 #: the signals the online drift monitor must expose in /debug/drift
 DRIFT_SIGNALS = (
@@ -79,6 +103,8 @@ REQUIRED_SAMPLES = (
     'rdp_decode_seconds_count{format="encoded"}',
     'rdp_host_stage_split_seconds_count{stage="decode"}',
     'rdp_host_stage_split_seconds_count{stage="encode"}',
+    # the journal records readiness as a structured event on every boot
+    'rdp_journal_events_total{kind="server.ready"}',
 )
 
 
@@ -182,9 +208,41 @@ def main() -> int:
             timeout=30,
         ) as resp:
             spans_payload = json.loads(resp.read().decode())
+        # the structured event journal tails from a cursor; a booted
+        # server has at least its server.ready event
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{servicer.metrics_server.port}"
+            "/debug/events?since=0",
+            timeout=30,
+        ) as resp:
+            events_payload = json.loads(resp.read().decode())
+        # the 404 help text enumerates the grown /debug surface
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{servicer.metrics_server.port}/nope",
+                timeout=30,
+            )
+            help_text = ""
+        except urllib.error.HTTPError as err:
+            help_text = err.read().decode()
     finally:
         server.stop(grace=None)
         servicer.close()
+
+    event_kinds = [e.get("kind") for e in events_payload.get("events", [])]
+    if "server.ready" not in event_kinds:
+        print(f"FAIL: /debug/events holds no server.ready event "
+              f"(kinds: {event_kinds})")
+        return 1
+    if events_payload.get("next_cursor", 0) < 1:
+        print(f"FAIL: /debug/events cursor never advanced: "
+              f"{events_payload}")
+        return 1
+    missing_endpoints = [e for e in DEBUG_ENDPOINTS if e not in help_text]
+    if missing_endpoints:
+        print(f"FAIL: 404 help text is missing endpoints "
+              f"{missing_endpoints}: {help_text!r}")
+        return 1
 
     decode_spans = [
         s for t in spans_payload.get("recent", [])
