@@ -3,125 +3,33 @@
 
 Exit code 0 when every ERROR-severity finding is either fixed or
 baselined-with-justification; 1 otherwise. Warnings are printed but do
-not fail the run (``--strict-warnings`` promotes them).
+not fail the run (``--strict-warnings`` promotes them). The flag
+surface is the shared analysis-framework driver, identical across
+rdp-jaxlint / rdp-racecheck / rdp-statecheck.
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import sys
-from pathlib import Path
 
+from robotic_discovery_platform_tpu.analysis import framework
 from robotic_discovery_platform_tpu.analysis.linter import (
     BASELINE_NAME,
     lint_paths,
-    write_baseline,
 )
-from robotic_discovery_platform_tpu.analysis.rules import ERROR, RULES
-
-
-def _find_default_baseline(paths: list[str]) -> Path | None:
-    """Nearest checked-in baseline: cwd first, then each lint root's
-    ancestors (so the CLI works from anywhere inside the repo)."""
-    candidates = [Path.cwd()] + [Path(p).resolve() for p in paths]
-    for base in candidates:
-        for directory in [base] + list(base.parents):
-            f = directory / BASELINE_NAME
-            if f.exists():
-                return f
-    return None
+from robotic_discovery_platform_tpu.analysis.rules import RULES
 
 
 def main(argv: list[str] | None = None) -> int:
-    parser = argparse.ArgumentParser(
+    return framework.run_cli(
         prog="rdp-jaxlint",
         description="JAX/TPU-aware static analysis (jaxlint)",
+        rules=RULES,
+        baseline_name=BASELINE_NAME,
+        check=lint_paths,
+        argv=argv,
+        support_strict_warnings=True,
     )
-    parser.add_argument(
-        "paths", nargs="*", default=["robotic_discovery_platform_tpu"],
-        help="files or directories to lint",
-    )
-    parser.add_argument(
-        "--baseline", type=Path, default=None,
-        help=f"baseline file (default: nearest {BASELINE_NAME})",
-    )
-    parser.add_argument(
-        "--no-baseline", action="store_true",
-        help="ignore any baseline file",
-    )
-    parser.add_argument(
-        "--write-baseline", type=Path, metavar="PATH",
-        help="write current findings as a baseline skeleton and exit "
-        "(justifications must then be filled in by hand)",
-    )
-    parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-    )
-    parser.add_argument(
-        "--strict-warnings", action="store_true",
-        help="exit nonzero on warnings too",
-    )
-    parser.add_argument(
-        "--list-rules", action="store_true", help="list rules and exit",
-    )
-    args = parser.parse_args(argv)
-
-    if args.list_rules:
-        for rule, desc in sorted(RULES.items()):
-            print(f"{rule}  {desc}")
-        return 0
-
-    baseline = None if args.no_baseline else (
-        args.baseline or _find_default_baseline(args.paths)
-    )
-    result = lint_paths(args.paths, baseline_path=baseline)
-
-    if args.write_baseline:
-        write_baseline(args.write_baseline, result.findings)
-        print(
-            f"wrote {len(result.findings)} entries to "
-            f"{args.write_baseline}; fill in every justification"
-        )
-        return 0
-
-    if args.format == "json":
-        print(json.dumps(
-            {
-                "findings": [vars(f) for f in result.findings],
-                "baselined": [vars(f) for f in result.baselined],
-                "stale_baseline": result.stale_baseline,
-            },
-            indent=2,
-        ))
-    else:
-        for f in result.findings:
-            print(f.render())
-        for e in result.stale_baseline:
-            print(
-                f"{e['file']}:{e['line']}: {e['rule']} [stale-baseline] "
-                "entry matches no finding; remove it"
-            )
-        if result.baselined:
-            print(
-                f"({len(result.baselined)} finding(s) suppressed by "
-                f"baseline {baseline})"
-            )
-
-    failing = [
-        f for f in result.findings
-        if f.severity == ERROR or args.strict_warnings
-    ]
-    if failing:
-        print(f"jaxlint: {len(failing)} failing finding(s)", file=sys.stderr)
-        return 1
-    if result.stale_baseline:
-        print(
-            f"jaxlint: {len(result.stale_baseline)} stale baseline "
-            "entry(ies)", file=sys.stderr,
-        )
-        return 1
-    return 0
 
 
 if __name__ == "__main__":

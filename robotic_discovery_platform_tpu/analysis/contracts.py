@@ -41,7 +41,8 @@ class ContractError(TypeError):
     """A shape/dtype contract violation at a public API boundary."""
 
 
-def _enabled() -> bool:
+def _resolve_enabled() -> bool:
+    """RDP_CONTRACTS resolver: contracts default on; 0/false/off kill."""
     return os.environ.get("RDP_CONTRACTS", "1") not in ("0", "false", "off")
 
 
@@ -142,7 +143,7 @@ def shape_contract(**specs):
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            if not _enabled():
+            if not _resolve_enabled():
                 return fn(*args, **kwargs)
             bound = sig.bind(*args, **kwargs)
             env: dict = {}

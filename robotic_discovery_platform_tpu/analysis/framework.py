@@ -1,0 +1,289 @@
+"""Shared driver plumbing for the platform's static-analysis tools.
+
+jaxlint (PR 1), racecheck (PR 11), and statecheck (PR 16) are three
+analyses with one operational contract: findings are fixed, suppressed
+inline (``# <tool>: disable=RULE``), or baselined-with-justification in
+a checked-in JSON file whose stale entries fail the run (the baseline
+only shrinks, never grows silently). This module is that contract,
+factored out so no tool carries its own copy:
+
+- :func:`suppressed_inline` -- the per-tool inline-disable comment map;
+- :func:`iter_python_files` / :func:`load_baseline` /
+  :func:`baseline_key` / :func:`split_baseline` /
+  :func:`write_baseline` -- the baseline mechanism;
+- :func:`find_default_baseline` -- nearest-ancestor baseline discovery;
+- :func:`run_cli` -- the whole argparse/text/json/exit-code driver, so
+  ``rdp-jaxlint``, ``rdp-racecheck``, and ``rdp-statecheck`` stay
+  flag-for-flag identical.
+
+Baseline format::
+
+    {
+      "version": 1,
+      "entries": [
+        {"file": "pkg/mod.py", "rule": "JL005", "line": 12,
+         "justification": "warm-up constant, built once per process"}
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Callable
+
+from robotic_discovery_platform_tpu.analysis.rules import ERROR, Finding
+
+
+def disable_re(tool: str) -> re.Pattern:
+    """The inline-suppression comment pattern for one tool, e.g.
+    ``# jaxlint: disable=JL001,JL005`` or ``# statecheck: disable``."""
+    return re.compile(rf"#\s*{tool}:\s*disable(?:=([A-Z0-9, ]+))?")
+
+
+def suppressed_inline(source: str, tool: str) -> dict[int, set[str] | None]:
+    """line -> set of disabled rules (None = all rules) for that line."""
+    pattern = disable_re(tool)
+    out: dict[int, set[str] | None] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = pattern.search(line)
+        if m:
+            rules = m.group(1)
+            out[i] = (
+                {r.strip() for r in rules.split(",") if r.strip()}
+                if rules else None
+            )
+    return out
+
+
+def apply_inline_suppressions(
+    findings: list[Finding], disabled: dict[int, set[str] | None]
+) -> list[Finding]:
+    """Drop findings whose line carries a matching disable comment."""
+    kept = []
+    for f in findings:
+        rules = disabled.get(f.line, "missing")
+        if rules == "missing" or (rules is not None and f.rule not in rules):
+            kept.append(f)
+    return sorted(kept, key=lambda f: (f.file, f.line, f.col, f.rule))
+
+
+def iter_python_files(paths: list[str]) -> list[Path]:
+    files: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if not any(part.startswith(".") for part in f.parts)
+            )
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def load_baseline(path: Path | None) -> list[dict]:
+    if path is None or not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    entries = data.get("entries", [])
+    for e in entries:
+        if not str(e.get("justification", "")).strip():
+            raise ValueError(
+                f"baseline entry {e.get('file')}:{e.get('line')} "
+                f"({e.get('rule')}) has no justification -- every "
+                "suppression must say why"
+            )
+    return entries
+
+
+def baseline_key(file: str, rule: str, line: int) -> tuple:
+    # normalized to repo-relative forward-slash paths so the baseline is
+    # stable across invocation directories
+    return (str(file).replace("\\", "/").lstrip("./"), rule, int(line))
+
+
+def find_default_baseline(
+    paths: list[str], baseline_name: str
+) -> Path | None:
+    """Nearest checked-in baseline: cwd first, then each lint root's
+    ancestors (so the CLI works from anywhere inside the repo)."""
+    candidates = [Path.cwd()] + [Path(p).resolve() for p in paths]
+    for base in candidates:
+        for directory in [base] + list(base.parents):
+            f = directory / baseline_name
+            if f.exists():
+                return f
+    return None
+
+
+@dataclasses.dataclass
+class CheckResult:
+    """One analysis run's findings, split against the baseline."""
+
+    findings: list[Finding]
+    baselined: list[Finding]
+    stale_baseline: list[dict]
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+
+def split_baseline(
+    findings: list[Finding], baseline_path: Path | None
+) -> CheckResult:
+    """Split findings into live / baselined, flagging stale entries."""
+    entries = load_baseline(baseline_path)
+    by_key = {
+        baseline_key(e["file"], e["rule"], e["line"]): e for e in entries
+    }
+    live: list[Finding] = []
+    baselined: list[Finding] = []
+    matched: set[tuple] = set()
+    for f in findings:
+        key = baseline_key(f.file, f.rule, f.line)
+        if key in by_key:
+            matched.add(key)
+            baselined.append(f)
+        else:
+            live.append(f)
+    stale = [e for k, e in by_key.items() if k not in matched]
+    return CheckResult(
+        findings=live, baselined=baselined, stale_baseline=stale
+    )
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    """Write a baseline skeleton for the given findings. Justifications
+    are intentionally left empty: the loader rejects empty ones, so each
+    must be filled in by hand before the baseline is usable."""
+    entries = [
+        {
+            "file": f.file.replace("\\", "/").lstrip("./"),
+            "rule": f.rule,
+            "line": f.line,
+            "severity": f.severity,
+            "message": f.message,
+            "justification": "",
+        }
+        for f in findings
+    ]
+    path.write_text(
+        json.dumps({"version": 1, "entries": entries}, indent=2) + "\n"
+    )
+
+
+def run_cli(
+    *,
+    prog: str,
+    description: str,
+    rules: dict[str, str],
+    baseline_name: str,
+    check: Callable[[list[str], Path | None], CheckResult],
+    argv: list[str] | None = None,
+    graph_fn: Callable[[list[str]], int] | None = None,
+    graph_help: str = "print the extracted graph and exit",
+    support_strict_warnings: bool = False,
+) -> int:
+    """The shared CLI driver: parse the standard flags, run ``check``,
+    render text/json, exit 1 on error findings or stale baseline."""
+    tool = prog.removeprefix("rdp-")
+    parser = argparse.ArgumentParser(prog=prog, description=description)
+    parser.add_argument(
+        "paths", nargs="*", default=["robotic_discovery_platform_tpu"],
+        help="files or directories to analyze",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help=f"baseline file (default: nearest {baseline_name})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file",
+    )
+    parser.add_argument(
+        "--write-baseline", type=Path, metavar="PATH",
+        help="write current findings as a baseline skeleton and exit "
+        "(justifications must then be filled in by hand)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    if support_strict_warnings:
+        parser.add_argument(
+            "--strict-warnings", action="store_true",
+            help="exit nonzero on warnings too",
+        )
+    if graph_fn is not None:
+        parser.add_argument(
+            "--graph", action="store_true", help=graph_help,
+        )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(rules.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    if graph_fn is not None and args.graph:
+        return graph_fn(args.paths)
+
+    baseline = None if args.no_baseline else (
+        args.baseline or find_default_baseline(args.paths, baseline_name)
+    )
+    result = check(args.paths, baseline)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, result.findings)
+        print(
+            f"wrote {len(result.findings)} entries to "
+            f"{args.write_baseline}; fill in every justification"
+        )
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [vars(f) for f in result.findings],
+                "baselined": [vars(f) for f in result.baselined],
+                "stale_baseline": result.stale_baseline,
+            },
+            indent=2,
+        ))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for e in result.stale_baseline:
+            print(
+                f"{e['file']}:{e['line']}: {e['rule']} [stale-baseline] "
+                "entry matches no finding; remove it"
+            )
+        if result.baselined:
+            print(
+                f"({len(result.baselined)} finding(s) suppressed by "
+                f"baseline {baseline})"
+            )
+
+    strict = support_strict_warnings and args.strict_warnings
+    failing = [
+        f for f in result.findings if f.severity == ERROR or strict
+    ]
+    if failing:
+        print(f"{tool}: {len(failing)} failing finding(s)",
+              file=sys.stderr)
+        return 1
+    if result.stale_baseline:
+        print(
+            f"{tool}: {len(result.stale_baseline)} stale baseline "
+            "entry(ies)", file=sys.stderr,
+        )
+        return 1
+    return 0

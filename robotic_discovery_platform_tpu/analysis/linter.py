@@ -4,27 +4,21 @@ The baseline file (default ``.jaxlint-baseline.json`` at the repo root)
 is the suppressed-with-justification mechanism: every entry must carry a
 non-empty ``justification`` naming why the finding is acceptable, and
 stale entries (matching nothing) are reported so the baseline can only
-shrink silently, never grow.
-
-Baseline format::
-
-    {
-      "version": 1,
-      "entries": [
-        {"file": "pkg/mod.py", "rule": "JL005", "line": 12,
-         "justification": "warm-up constant, built once per process"}
-      ]
-    }
+shrink silently, never grow. The mechanism itself -- file walking,
+inline disables, baseline split, CLI -- lives in
+:mod:`robotic_discovery_platform_tpu.analysis.framework`, shared with
+racecheck and statecheck; this module binds it to the jaxlint rules.
 """
 
 from __future__ import annotations
 
 import ast
-import dataclasses
-import json
-import re
 from pathlib import Path
 
+from robotic_discovery_platform_tpu.analysis import framework
+from robotic_discovery_platform_tpu.analysis.framework import (
+    CheckResult as LintResult,
+)
 from robotic_discovery_platform_tpu.analysis.rules import (
     ERROR,
     Finding,
@@ -33,132 +27,43 @@ from robotic_discovery_platform_tpu.analysis.rules import (
 
 BASELINE_NAME = ".jaxlint-baseline.json"
 
-_DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable(?:=([A-Z0-9, ]+))?")
-
-
-@dataclasses.dataclass
-class LintResult:
-    findings: list[Finding]
-    baselined: list[Finding]
-    stale_baseline: list[dict]
-
-    @property
-    def errors(self) -> list[Finding]:
-        return [f for f in self.findings if f.severity == ERROR]
+# kept as module attributes for importers of the pre-framework surface
+_DISABLE_RE = framework.disable_re("jaxlint")
+iter_python_files = framework.iter_python_files
+load_baseline = framework.load_baseline
+_baseline_key = framework.baseline_key
+write_baseline = framework.write_baseline
 
 
 def _suppressed_inline(source: str) -> dict[int, set[str] | None]:
     """line -> set of disabled rules (None = all rules) for that line."""
-    out: dict[int, set[str] | None] = {}
-    for i, line in enumerate(source.splitlines(), start=1):
-        m = _DISABLE_RE.search(line)
-        if m:
-            rules = m.group(1)
-            out[i] = (
-                {r.strip() for r in rules.split(",") if r.strip()}
-                if rules else None
-            )
-    return out
+    return framework.suppressed_inline(source, "jaxlint")
 
 
 def lint_source(source: str, path: str = "<string>") -> list[Finding]:
     """Findings for one source blob, inline suppressions applied."""
     tree = ast.parse(source, filename=path)
     findings = check_module(tree, path)
-    disabled = _suppressed_inline(source)
-    kept = []
-    for f in findings:
-        rules = disabled.get(f.line, "missing")
-        if rules == "missing" or (rules is not None and f.rule not in rules):
-            kept.append(f)
-    return sorted(kept, key=lambda f: (f.file, f.line, f.col, f.rule))
-
-
-def iter_python_files(paths: list[str]) -> list[Path]:
-    files: list[Path] = []
-    for raw in paths:
-        p = Path(raw)
-        if p.is_dir():
-            files.extend(
-                f for f in sorted(p.rglob("*.py"))
-                if not any(part.startswith(".") for part in f.parts)
-            )
-        elif p.suffix == ".py":
-            files.append(p)
-    return files
-
-
-def load_baseline(path: Path | None) -> list[dict]:
-    if path is None or not path.exists():
-        return []
-    data = json.loads(path.read_text())
-    entries = data.get("entries", [])
-    for e in entries:
-        if not str(e.get("justification", "")).strip():
-            raise ValueError(
-                f"baseline entry {e.get('file')}:{e.get('line')} "
-                f"({e.get('rule')}) has no justification -- every "
-                "suppression must say why"
-            )
-    return entries
-
-
-def _baseline_key(file: str, rule: str, line: int) -> tuple:
-    # normalized to repo-relative forward-slash paths so the baseline is
-    # stable across invocation directories
-    return (str(file).replace("\\", "/").lstrip("./"), rule, int(line))
+    return framework.apply_inline_suppressions(
+        findings, _suppressed_inline(source)
+    )
 
 
 def lint_paths(
     paths: list[str], baseline_path: Path | None = None
 ) -> LintResult:
     """Lint every .py under ``paths``; split findings by baseline."""
-    entries = load_baseline(baseline_path)
-    by_key = {
-        _baseline_key(e["file"], e["rule"], e["line"]): e for e in entries
-    }
-    live: list[Finding] = []
-    baselined: list[Finding] = []
-    matched: set[tuple] = set()
+    findings: list[Finding] = []
     for f_path in iter_python_files(paths):
         try:
             source = f_path.read_text()
         except (OSError, UnicodeDecodeError):
             continue
         try:
-            findings = lint_source(source, str(f_path))
+            findings.extend(lint_source(source, str(f_path)))
         except SyntaxError as exc:
-            live.append(Finding(
+            findings.append(Finding(
                 str(f_path), exc.lineno or 1, 0, "JL000", ERROR,
                 f"syntax error: {exc.msg}",
             ))
-            continue
-        for f in findings:
-            key = _baseline_key(f.file, f.rule, f.line)
-            if key in by_key:
-                matched.add(key)
-                baselined.append(f)
-            else:
-                live.append(f)
-    stale = [e for k, e in by_key.items() if k not in matched]
-    return LintResult(findings=live, baselined=baselined, stale_baseline=stale)
-
-
-def write_baseline(path: Path, findings: list[Finding]) -> None:
-    """Write a baseline skeleton for the given findings. Justifications are
-    intentionally left as FIXMEs: the loader rejects empty ones, so each
-    must be filled in by hand before the baseline is usable."""
-    entries = [
-        {
-            "file": f.file.replace("\\", "/").lstrip("./"),
-            "rule": f.rule,
-            "line": f.line,
-            "severity": f.severity,
-            "message": f.message,
-            "justification": "",
-        }
-        for f in findings
-    ]
-    path.write_text(
-        json.dumps({"version": 1, "entries": entries}, indent=2) + "\n"
-    )
+    return framework.split_baseline(findings, baseline_path)

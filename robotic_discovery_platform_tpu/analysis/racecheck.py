@@ -58,18 +58,15 @@ sanitizers catch what dynamic callgraphs hide.
 
 from __future__ import annotations
 
-import argparse
 import ast
-import json
 import re
 import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from robotic_discovery_platform_tpu.analysis.linter import (
-    _baseline_key,
+from robotic_discovery_platform_tpu.analysis import framework
+from robotic_discovery_platform_tpu.analysis.framework import (
     iter_python_files,
-    load_baseline,
 )
 from robotic_discovery_platform_tpu.analysis.rules import ERROR, Finding
 
@@ -81,7 +78,6 @@ RC_RULES = {
     "RC003": "blocking call under a held lock",
 }
 
-_DISABLE_RE = re.compile(r"#\s*racecheck:\s*disable(?:=([A-Z0-9, ]+))?")
 _GUARDED_BY_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 
 #: constructors that make a lock-like object we track in the order graph
@@ -163,19 +159,11 @@ class FunctionSummary:
 def _comment_maps(source: str):
     """lineno -> guarded_by attr, and lineno -> disabled rule set."""
     guards: dict[int, str] = {}
-    disabled: dict[int, set | None] = {}
     for i, line in enumerate(source.splitlines(), start=1):
         g = _GUARDED_BY_RE.search(line)
         if g:
             guards[i] = g.group(1)
-        d = _DISABLE_RE.search(line)
-        if d:
-            rules = d.group(1)
-            disabled[i] = (
-                {r.strip() for r in rules.split(",") if r.strip()}
-                if rules else None
-            )
-    return guards, disabled
+    return guards, framework.suppressed_inline(source, "racecheck")
 
 
 def _ctor_name(value: ast.AST) -> str | None:
@@ -740,110 +728,33 @@ def _summary_path(qual: str, modules: dict) -> str:
 # -- driver / CLI ------------------------------------------------------------
 
 
-def check_paths(paths: list[str], baseline_path: Path | None = None):
-    """(live findings, baselined findings, stale entries, graph)."""
-    entries = load_baseline(baseline_path)
-    by_key = {
-        _baseline_key(e["file"], e["rule"], e["line"]): e for e in entries
-    }
+def check_paths(
+    paths: list[str], baseline_path: Path | None = None
+) -> framework.CheckResult:
+    """Analyze ``paths`` and split the findings against the baseline."""
     result = analyze_paths(paths)
-    live, baselined = [], []
-    matched: set[tuple] = set()
-    for f in result.findings:
-        key = _baseline_key(f.file, f.rule, f.line)
-        if key in by_key:
-            matched.add(key)
-            baselined.append(f)
-        else:
-            live.append(f)
-    stale = [e for k, e in by_key.items() if k not in matched]
-    return live, baselined, stale, result.graph
+    return framework.split_baseline(result.findings, baseline_path)
 
 
-def _find_default_baseline(paths: list[str]) -> Path | None:
-    candidates = [Path.cwd()] + [Path(p).resolve() for p in paths]
-    for base in candidates:
-        for directory in [base] + list(base.parents):
-            f = directory / BASELINE_NAME
-            if f.exists():
-                return f
-    return None
+def _print_graph(paths: list[str]) -> int:
+    result = analyze_paths(paths)
+    for (a, b), (path, line) in sorted(result.graph.edges.items()):
+        print(f"{a} -> {b}   ({Path(path).name}:{line})")
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
-    parser = argparse.ArgumentParser(
+    return framework.run_cli(
         prog="rdp-racecheck",
         description="Static concurrency analysis (lock order, guarded_by,"
                     " blocking-under-lock)",
+        rules=RC_RULES,
+        baseline_name=BASELINE_NAME,
+        check=check_paths,
+        argv=argv,
+        graph_fn=_print_graph,
+        graph_help="print the lock-order edge list and exit",
     )
-    parser.add_argument(
-        "paths", nargs="*", default=["robotic_discovery_platform_tpu"],
-    )
-    parser.add_argument("--baseline", type=Path, default=None)
-    parser.add_argument("--no-baseline", action="store_true")
-    parser.add_argument("--write-baseline", type=Path, metavar="PATH")
-    parser.add_argument("--format", choices=("text", "json"),
-                        default="text")
-    parser.add_argument("--graph", action="store_true",
-                        help="print the lock-order edge list and exit")
-    parser.add_argument("--list-rules", action="store_true")
-    args = parser.parse_args(argv)
-
-    if args.list_rules:
-        for rule, desc in sorted(RC_RULES.items()):
-            print(f"{rule}  {desc}")
-        return 0
-
-    baseline = None if args.no_baseline else (
-        args.baseline or _find_default_baseline(args.paths)
-    )
-    if args.graph:
-        result = analyze_paths(args.paths)
-        for (a, b), (path, line) in sorted(result.graph.edges.items()):
-            print(f"{a} -> {b}   ({Path(path).name}:{line})")
-        return 0
-    live, baselined, stale, _graph = check_paths(
-        args.paths, baseline_path=baseline
-    )
-
-    if args.write_baseline:
-        entries = [
-            {"file": f.file.replace("\\", "/").lstrip("./"),
-             "rule": f.rule, "line": f.line, "severity": f.severity,
-             "message": f.message, "justification": ""}
-            for f in live
-        ]
-        args.write_baseline.write_text(json.dumps(
-            {"version": 1, "entries": entries}, indent=2) + "\n")
-        print(f"wrote {len(live)} entries to {args.write_baseline}; "
-              "fill in every justification")
-        return 0
-
-    if args.format == "json":
-        print(json.dumps({
-            "findings": [vars(f) for f in live],
-            "baselined": [vars(f) for f in baselined],
-            "stale_baseline": stale,
-        }, indent=2))
-    else:
-        for f in live:
-            print(f.render())
-        for e in stale:
-            print(f"{e['file']}:{e['line']}: {e['rule']} [stale-baseline] "
-                  "entry matches no finding; remove it")
-        if baselined:
-            print(f"({len(baselined)} finding(s) suppressed by baseline "
-                  f"{baseline})")
-    failing = [f for f in live if f.severity == ERROR]
-    if failing:
-        print(f"racecheck: {len(failing)} failing finding(s)",
-              file=sys.stderr)
-        return 1
-    if stale:
-        print(f"racecheck: {len(stale)} stale baseline entry(ies)",
-              file=sys.stderr)
-        return 1
-    return 0
 
 
 if __name__ == "__main__":

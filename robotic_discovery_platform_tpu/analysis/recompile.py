@@ -59,7 +59,8 @@ class GuardStats:
         return self.budget if self.budget is not None else DEFAULT_BUDGET
 
 
-def _strict() -> bool:
+def _resolve_strict() -> bool:
+    """RDP_RECOMPILE_STRICT resolver: test-hook override wins, then env."""
     if _strict_override is not None:
         return _strict_override
     return os.environ.get("RDP_RECOMPILE_STRICT", "0") not in (
@@ -140,7 +141,7 @@ def trace_guard(
                     "stabilize the argument shapes/dtypes (or raise the "
                     "declared budget if this shape set is intended)."
                 )
-                if _strict():
+                if _resolve_strict():
                     raise RecompileBudgetExceeded(msg)
                 log.warning(msg)
             return fn(*args, **kwargs)

@@ -57,6 +57,13 @@ JL013     error     a lock/semaphore/condition attribute created outside
                     splits its waiters across two objects (threads
                     holding the OLD lock no longer exclude threads
                     acquiring the NEW one)
+JL014     error     an ``os.environ``/``getenv`` read of an ``RDP_*``
+                    knob outside a ``resolve_*`` helper: every env knob
+                    has exactly one resolver (the established
+                    convention), so precedence (env over config), parse
+                    tolerance, and documentation live in one greppable
+                    place instead of being re-decided ad hoc at each
+                    read site
 ========  ========  =====================================================
 
 "Jitted code" is computed statically: functions decorated with
@@ -91,6 +98,7 @@ RULES = {
     "JL011": "possibly-implicit transfer inside jitted code",
     "JL012": "thread started without a join/stop owner",
     "JL013": "lock attribute created outside __init__",
+    "JL014": "RDP_* env knob read outside a resolve_* helper",
 }
 
 _JIT_WRAPPERS = {
@@ -626,6 +634,52 @@ def _concurrency_findings(
                         ))
 
 
+def _rdp_env_key(node: ast.AST) -> str | None:
+    """The RDP_* knob name if this expression is a literal string
+    starting with RDP_, else None."""
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.startswith("RDP_")):
+        return node.value
+    return None
+
+
+def _env_knob_findings(
+    tree: ast.Module, aliases: _Aliases, out: list[Finding], path: str
+) -> None:
+    # JL014: an os.environ/getenv read of an RDP_* knob outside a
+    # resolve_* helper. Each knob has exactly one resolver so precedence
+    # (env over config), parse tolerance, and docs live in one place.
+    def exempt(stack: list[str]) -> bool:
+        return any(n.lstrip("_").startswith("resolve") for n in stack)
+
+    def visit(node: ast.AST, stack: list[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node.name]
+        key = None
+        if isinstance(node, ast.Call):
+            name = aliases.canonical(node.func) or ""
+            if name in ("os.getenv", "os.environ.get") and node.args:
+                key = _rdp_env_key(node.args[0])
+        elif isinstance(node, ast.Subscript):
+            name = aliases.canonical(node.value) or ""
+            if name == "os.environ":
+                key = _rdp_env_key(node.slice)
+        if key is not None and not exempt(stack):
+            out.append(Finding(
+                path, node.lineno, node.col_offset, "JL014", ERROR,
+                f"env knob {key} read outside a resolve_* helper: every "
+                "RDP_* knob has exactly one resolver function so "
+                "precedence (env over config), parse tolerance, and "
+                "documentation live in one greppable place -- move the "
+                "read into a resolve_* helper or justify the exception "
+                "with an inline disable",
+            ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(tree, [])
+
+
 # -- Pallas kernel-body rules (JL008-JL010) ---------------------------------
 #
 # These fire only on modules that import jax.experimental.pallas, and only
@@ -867,5 +921,6 @@ def check_module(tree: ast.Module, path: str) -> list[Finding]:
     _static_param_findings(tree, aliases, out, path)
     _module_level_findings(tree, aliases, out, path)
     _concurrency_findings(tree, aliases, out, path)
+    _env_knob_findings(tree, aliases, out, path)
     _pallas_findings(tree, aliases, out, path)
     return out

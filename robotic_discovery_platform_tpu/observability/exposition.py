@@ -115,6 +115,12 @@ def render(registry: MetricsRegistry = REGISTRY) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _resolve_profile_dir(configured: str | None) -> str:
+    """RDP_PROFILE_DIR resolver: explicit config wins, then the env knob;
+    empty means on-demand profiling is off (409 from /debug/profile)."""
+    return (configured or os.environ.get("RDP_PROFILE_DIR", "")).strip()
+
+
 class MetricsServer:
     """``GET /metrics`` + ``/debug/*`` over stdlib ``http.server``, on a
     daemon thread.
@@ -260,9 +266,7 @@ class MetricsServer:
                 into RDP_PROFILE_DIR; the capture runs synchronously on
                 this handler thread (ThreadingHTTPServer keeps /metrics
                 scrapes responsive meanwhile)."""
-                profile_dir = (outer._profile_dir
-                               or os.environ.get("RDP_PROFILE_DIR", "")
-                               ).strip()
+                profile_dir = _resolve_profile_dir(outer._profile_dir)
                 if not profile_dir:
                     self._send_json(
                         {"error": "no profile directory configured; set "

@@ -1,0 +1,102 @@
+"""Central registry of every ``rdp_*`` metric family name.
+
+One constant per family, grouped by subsystem -- the single source of
+truth the instruments module declares against, the smoke tools assert
+against, and statecheck's SC004 lints against: an ``rdp_*`` string
+literal anywhere else in the package that is absent from this module is
+operational-surface drift (a family dashboards and alerts can never have
+heard of). Import the constant, never retype the string.
+
+Zero imports on purpose: this module must be loadable from anywhere
+(tools/, analysis/, tests) without dragging in the metrics runtime.
+"""
+
+from __future__ import annotations
+
+FRAMES = "rdp_frames_total"
+STAGE_LATENCY = "rdp_stage_latency_seconds"
+INFLIGHT_STREAMS = "rdp_inflight_streams"
+STAGE_LATENCY_SUMMARY = "rdp_stage_latency_summary_seconds"
+FRAME_LATENCY_SUMMARY = "rdp_frame_latency_summary_seconds"
+SERVING_PRECISION = "rdp_serving_precision"
+QUANT_PARITY_IOU = "rdp_quant_parity_iou"
+QUANT_PARITY_CURV = "rdp_quant_parity_curvature_err"
+SLO_OBJECTIVE = "rdp_slo_objective_seconds"
+SLO_VIOLATIONS = "rdp_slo_violations_total"
+SLO_BURN = "rdp_slo_error_budget_burn"
+DRIFT_SCORE = "rdp_drift_score"
+DRIFT_RECOMMENDATIONS = "rdp_drift_recommendations_total"
+DRIFT_REFERENCE_AGE = "rdp_drift_reference_age_seconds"
+MODEL_CONFIDENCE_MARGIN = "rdp_model_confidence_margin"
+METRICS_ROWS_SKIPPED = "rdp_metrics_rows_skipped_total"
+DRIFT_PROFILE_FAILURES = "rdp_drift_profile_failures_total"
+ROLLOUT_STATE = "rdp_rollout_state"
+ROLLOUT_TRANSITIONS = "rdp_rollout_transitions_total"
+ROLLOUT_SHADOW_FRAMES = "rdp_rollout_shadow_frames_total"
+ROLLOUT_GATE_VERDICTS = "rdp_rollout_gate_verdicts_total"
+ROLLOUT_ROLLBACKS = "rdp_rollout_rollbacks_total"
+ROLLOUT_CYCLES = "rdp_rollout_cycles_total"
+ROLLOUT_SKIPPED = "rdp_rollout_skipped_total"
+ZOO_MODELS = "rdp_zoo_models"
+MODEL_ARRIVAL_RATE = "rdp_model_arrival_rate"
+MODEL_CHIPS = "rdp_model_chips"
+MODEL_DISPATCHES = "rdp_model_dispatches_total"
+ZOO_REBALANCES = "rdp_zoo_rebalances_total"
+MODEL_ANOMALY_SCORE = "rdp_model_anomaly_score"
+DECODE_SECONDS = "rdp_decode_seconds"
+DECODE_QUEUE_DEPTH = "rdp_decode_queue_depth"
+GEOMETRY_CACHE_HITS = "rdp_geometry_cache_hits_total"
+GEOMETRY_CACHE_MISSES = "rdp_geometry_cache_misses_total"
+HOST_STAGE_SPLIT = "rdp_host_stage_split_seconds"
+BATCH_QUEUE_DEPTH = "rdp_batch_queue_depth"
+BATCH_SIZE = "rdp_batch_size_frames"
+WATCHDOG_RESTARTS = "rdp_batch_watchdog_restarts_total"
+INFLIGHT_DISPATCHES = "rdp_batch_inflight_dispatches"
+DISPATCH_OVERLAP = "rdp_batch_overlap_seconds"
+BATCH_STAGE_LATENCY = "rdp_batch_stage_seconds"
+SERVING_CHIPS = "rdp_serving_chips"
+CHIP_DISPATCHES = "rdp_chip_dispatches_total"
+CHIP_FRAMES = "rdp_chip_frames_total"
+CHIP_INFLIGHT = "rdp_chip_inflight_dispatches"
+BATCH_POOL_SIZE = "rdp_batch_pool_size"
+SHED_BY_DEADLINE = "rdp_shed_by_deadline_total"
+CONTROLLER_LEVEL = "rdp_controller_brownout_level"
+CONTROLLER_INFLIGHT = "rdp_controller_max_inflight"
+CONTROLLER_WINDOW_MS = "rdp_controller_window_ms"
+CONTROLLER_ACTIONS = "rdp_controller_actions_total"
+QUARANTINED_CHIPS = "rdp_quarantined_chips"
+CHIP_QUARANTINES = "rdp_chip_quarantines_total"
+CHIP_FAILOVER_FRAMES = "rdp_chip_failover_frames_total"
+FLEET_REPLICAS_LIVE = "rdp_fleet_replicas_live"
+FLEET_REPLICAS_QUARANTINED = "rdp_fleet_replicas_quarantined"
+FLEET_REPLICAS_DRAINING = "rdp_fleet_replicas_draining"
+FLEET_REPLICA_STREAMS = "rdp_fleet_replica_streams"
+FLEET_REPLICA_FRAMES = "rdp_fleet_replica_frames_total"
+FLEET_REPLICA_BURN = "rdp_fleet_replica_burn"
+FLEET_REPLICA_WEIGHT = "rdp_fleet_replica_weight"
+FLEET_PLACEMENTS = "rdp_fleet_placements_total"
+FLEET_FAILOVERS = "rdp_fleet_failovers_total"
+FLEET_FAILOVER_FRAMES = "rdp_fleet_failover_frames_total"
+FLEET_CONTROLLER_ACTIONS = "rdp_fleet_controller_actions_total"
+REPLICA_UP = "rdp_replica_up"
+REPLICA_SCRAPE_AGE = "rdp_replica_scrape_age_seconds"
+REPLICA_DRAINING = "rdp_replica_draining"
+FLEET_BURN = "rdp_fleet_burn"
+FLEET_FRAMES = "rdp_fleet_frames"
+FLEET_MODEL_ARRIVAL_RATE = "rdp_fleet_model_arrival_rate"
+JOURNAL_EVENTS = "rdp_journal_events_total"
+JOURNAL_DROPPED = "rdp_journal_dropped_total"
+BREAKER_STATE = "rdp_breaker_state"
+BREAKER_TRANSITIONS = "rdp_breaker_transitions_total"
+RETRIES = "rdp_retry_attempts_total"
+HTTP_REQUESTS = "rdp_http_request_seconds"
+TRAIN_STEP = "rdp_train_step_seconds"
+TRAIN_RATE = "rdp_train_examples_per_second"
+
+
+#: every family above, in declaration order -- the smoke tools iterate
+#: this instead of hand-copied string lists
+ALL_FAMILIES = tuple(
+    v for k, v in sorted(globals().items())
+    if k.isupper() and isinstance(v, str) and v.startswith("rdp_")
+)

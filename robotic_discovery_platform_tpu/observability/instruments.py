@@ -16,6 +16,8 @@ same functions).
 from __future__ import annotations
 
 from robotic_discovery_platform_tpu.observability import (
+    events,
+    families,
     journal as journal_lib,
 )
 from robotic_discovery_platform_tpu.observability.registry import (
@@ -25,7 +27,7 @@ from robotic_discovery_platform_tpu.observability.registry import (
 # -- serving -----------------------------------------------------------------
 
 FRAMES = REGISTRY.counter(
-    "rdp_frames_total",
+    families.FRAMES,
     "Frames handled by the analysis server, by terminal status "
     "(ok, degraded, error, deadline, shed) and served zoo model "
     "(models/variants.py; 'seg' is the default binary segmenter, "
@@ -33,23 +35,23 @@ FRAMES = REGISTRY.counter(
     ("status", "model"),
 )
 STAGE_LATENCY = REGISTRY.histogram(
-    "rdp_stage_latency_seconds",
+    families.STAGE_LATENCY,
     "Per-frame serving stage latency (decode, device, encode, total).",
     ("stage",),
 )
 INFLIGHT_STREAMS = REGISTRY.gauge(
-    "rdp_inflight_streams",
+    families.INFLIGHT_STREAMS,
     "gRPC analysis streams currently open.",
 )
 STAGE_LATENCY_SUMMARY = REGISTRY.summary(
-    "rdp_stage_latency_summary_seconds",
+    families.STAGE_LATENCY_SUMMARY,
     "Streaming-quantile companion to rdp_stage_latency_seconds: "
     "P^2-estimated p50/p95/p99/p99.9 per serving stage (decode, device, "
     "encode, total), with no histogram bucket-resolution floor.",
     ("stage",),
 )
 FRAME_LATENCY_SUMMARY = REGISTRY.summary(
-    "rdp_frame_latency_summary_seconds",
+    families.FRAME_LATENCY_SUMMARY,
     "End-to-end per-frame latency quantiles (request read to response "
     "write) -- the SLO tracker's signal.",
 )
@@ -57,13 +59,13 @@ FRAME_LATENCY_SUMMARY = REGISTRY.summary(
 # -- precision tiers (ops/pallas/quant.py; ServerConfig.precision) -----------
 
 SERVING_PRECISION = REGISTRY.gauge(
-    "rdp_serving_precision",
+    families.SERVING_PRECISION,
     "Info gauge: 1 on the label of the active serving precision tier "
     "(f32, bf16, int8), 0 on the others.",
     ("precision",),
 )
 QUANT_PARITY_IOU = REGISTRY.gauge(
-    "rdp_quant_parity_iou",
+    families.QUANT_PARITY_IOU,
     "Mean mask IoU of the reduced-precision serving engine against the "
     "f32 goldens, measured at the warm-up parity check (1.0 at the f32 "
     "tier by definition; serving refuses to start below "
@@ -71,7 +73,7 @@ QUANT_PARITY_IOU = REGISTRY.gauge(
     ("model",),
 )
 QUANT_PARITY_CURV = REGISTRY.gauge(
-    "rdp_quant_parity_curvature_err",
+    families.QUANT_PARITY_CURV,
     "Absolute curvature delta (1/m) of the reduced-precision engine vs "
     "the f32 goldens at the warm-up parity check, by stat (mean, max) "
     "and served zoo model; the max drives the startup gate "
@@ -82,19 +84,19 @@ QUANT_PARITY_CURV = REGISTRY.gauge(
 # -- SLO (observability/slo.py; ServerConfig.slo_ms / RDP_SLO_MS) ------------
 
 SLO_OBJECTIVE = REGISTRY.gauge(
-    "rdp_slo_objective_seconds",
+    families.SLO_OBJECTIVE,
     "Configured latency objective per tracked signal (absent families "
     "mean SLO tracking is off).",
     ("objective",),
 )
 SLO_VIOLATIONS = REGISTRY.counter(
-    "rdp_slo_violations_total",
+    families.SLO_VIOLATIONS,
     "Frames that missed their latency objective (slower than the "
     "objective, shed, or errored), per tracked signal.",
     ("objective",),
 )
 SLO_BURN = REGISTRY.gauge(
-    "rdp_slo_error_budget_burn",
+    families.SLO_BURN,
     "Error-budget burn rate: sliding-window violation fraction divided "
     "by the budgeted fraction (ServerConfig.slo_budget). Sustained "
     "values > 1 mean the objective is being breached -- the adaptive "
@@ -107,7 +109,7 @@ SLO_BURN = REGISTRY.gauge(
 # -- drift observability (monitoring/profile.py; ServerConfig.drift_*) -------
 
 DRIFT_SCORE = REGISTRY.gauge(
-    "rdp_drift_score",
+    families.DRIFT_SCORE,
     "Live-vs-reference population stability index (PSI) per monitored "
     "serving signal (mask_coverage, mean_curvature, max_curvature, "
     "depth_valid_fraction, confidence_margin) and served zoo model "
@@ -118,20 +120,20 @@ DRIFT_SCORE = REGISTRY.gauge(
     ("signal", "model"),
 )
 DRIFT_RECOMMENDATIONS = REGISTRY.counter(
-    "rdp_drift_recommendations_total",
+    families.DRIFT_RECOMMENDATIONS,
     "Structured retrain recommendations fired by the online drift "
     "monitor (hysteresis-gated: one per sustained excursion; each is "
     "also pinned in the flight recorder and visible in /debug/drift).",
 )
 DRIFT_REFERENCE_AGE = REGISTRY.gauge(
-    "rdp_drift_reference_age_seconds",
+    families.DRIFT_REFERENCE_AGE,
     "Age of the drift monitor's reference profile (registry artifact or "
     "self-baseline); re-stamped when a hot-reload adopts a new "
     "generation's profile. -1 while no reference exists yet "
     "(self-baselining in progress).",
 )
 MODEL_CONFIDENCE_MARGIN = REGISTRY.histogram(
-    "rdp_model_confidence_margin",
+    families.MODEL_CONFIDENCE_MARGIN,
     "Per-frame segmentation confidence margin: mean |sigmoid(logit) - "
     "0.5| over the model-resolution output (0 = maximally uncertain, "
     "0.5 = saturated). A drop is the classic early signal of the model "
@@ -139,13 +141,13 @@ MODEL_CONFIDENCE_MARGIN = REGISTRY.histogram(
     buckets=(0.05, 0.1, 0.15, 0.2, 0.25, 0.3, 0.35, 0.4, 0.45, 0.5),
 )
 METRICS_ROWS_SKIPPED = REGISTRY.counter(
-    "rdp_metrics_rows_skipped_total",
+    families.METRICS_ROWS_SKIPPED,
     "Non-finite per-frame metric rows (nan/inf curvature or coverage) "
     "skipped by the CSV MetricsWriter instead of being written into the "
     "log the offline drift detector consumes.",
 )
 DRIFT_PROFILE_FAILURES = REGISTRY.counter(
-    "rdp_drift_profile_failures_total",
+    families.DRIFT_PROFILE_FAILURES,
     "Retraining-pipeline drift-profile captures that failed (the "
     "promoted version shipped no reference artifact, so every server "
     "adopting it silently self-baselines on its own early traffic "
@@ -156,20 +158,20 @@ DRIFT_PROFILE_FAILURES = REGISTRY.counter(
 # -- drift-triggered rollout (serving/rollout.py; RolloutConfig) --------------
 
 ROLLOUT_STATE = REGISTRY.gauge(
-    "rdp_rollout_state",
+    families.ROLLOUT_STATE,
     "Info gauge: 1 on the label of the rollout state machine's current "
     "stage (idle, draining, retraining, shadow, canary, promoting, "
     "rejoining), 0 on the others.",
     ("state",),
 )
 ROLLOUT_TRANSITIONS = REGISTRY.counter(
-    "rdp_rollout_transitions_total",
+    families.ROLLOUT_TRANSITIONS,
     "Rollout state-machine transitions, by destination stage (each is "
     "also pinned in the flight recorder).",
     ("to",),
 )
 ROLLOUT_SHADOW_FRAMES = REGISTRY.counter(
-    "rdp_rollout_shadow_frames_total",
+    families.ROLLOUT_SHADOW_FRAMES,
     "Live frames mirrored to the shadow candidate, by outcome: "
     "'mirrored' (sampled into the shadow queue), 'diffed' (candidate "
     "ran it and the diff was scored), 'dropped' (shadow queue full -- "
@@ -178,26 +180,26 @@ ROLLOUT_SHADOW_FRAMES = REGISTRY.counter(
     ("outcome",),
 )
 ROLLOUT_GATE_VERDICTS = REGISTRY.counter(
-    "rdp_rollout_gate_verdicts_total",
+    families.ROLLOUT_GATE_VERDICTS,
     "Promotion-gate evaluations, by gate (fixture_iou, fixture_curv, "
     "shadow_iou, shadow_curv, shadow_psi, shadow_frames) and verdict "
     "(pass, fail). Promotion requires every gate to pass -- fail-closed.",
     ("gate", "verdict"),
 )
 ROLLOUT_ROLLBACKS = REGISTRY.counter(
-    "rdp_rollout_rollbacks_total",
+    families.ROLLOUT_ROLLBACKS,
     "Rollout cycles rolled back, by the stage that failed or timed out "
     "(the candidate is discarded, the drained replica rejoins, and the "
     "fleet keeps serving the old generation).",
     ("stage",),
 )
 ROLLOUT_CYCLES = REGISTRY.counter(
-    "rdp_rollout_cycles_total",
+    families.ROLLOUT_CYCLES,
     "Completed rollout cycles, by outcome (promoted, rolled_back).",
     ("outcome",),
 )
 ROLLOUT_SKIPPED = REGISTRY.counter(
-    "rdp_rollout_skipped_total",
+    families.ROLLOUT_SKIPPED,
     "Retrain recommendations the rollout manager did NOT act on, by "
     "reason: 'busy' (a cycle is already running), 'no_spare_replica' "
     "(draining one would leave nothing serving -- the loop never trades "
@@ -208,19 +210,19 @@ ROLLOUT_SKIPPED = REGISTRY.counter(
 # -- model zoo + statistical multiplexing (serving/zoo.py) -------------------
 
 ZOO_MODELS = REGISTRY.gauge(
-    "rdp_zoo_models",
+    families.ZOO_MODELS,
     "Model-zoo entries this server holds (1 = the legacy single-model "
     "server; the default binary segmenter is always one of them).",
 )
 MODEL_ARRIVAL_RATE = REGISTRY.gauge(
-    "rdp_model_arrival_rate",
+    families.MODEL_ARRIVAL_RATE,
     "Mean per-model arrival rate (frames/sec) over the ZooPlacer's "
     "sliding rate window -- the statistical-multiplexing placement "
     "signal, and the capacity planner's per-model demand input.",
     ("model",),
 )
 MODEL_CHIPS = REGISTRY.gauge(
-    "rdp_model_chips",
+    families.MODEL_CHIPS,
     "Mesh chips each zoo model is currently placed on (AlpaServe-style "
     "shared placement co-locates anti-correlated models, so the per-"
     "model counts sum to MORE than the mesh width under multiplexing; "
@@ -228,19 +230,19 @@ MODEL_CHIPS = REGISTRY.gauge(
     ("model",),
 )
 MODEL_DISPATCHES = REGISTRY.counter(
-    "rdp_model_dispatches_total",
+    families.MODEL_DISPATCHES,
     "Batched dispatches launched per zoo model (each dispatch carries "
     "exactly one model's frames).",
     ("model",),
 )
 ZOO_REBALANCES = REGISTRY.counter(
-    "rdp_zoo_rebalances_total",
+    families.ZOO_REBALANCES,
     "ZooPlacer re-placements that CHANGED the model->chips assignment "
     "(recomputed every ServerConfig.zoo_rebalance_s from the measured "
     "per-model rate correlations).",
 )
 MODEL_ANOMALY_SCORE = REGISTRY.histogram(
-    "rdp_model_anomaly_score",
+    families.MODEL_ANOMALY_SCORE,
     "Per-frame defect/anomaly score from the aux head (1 - 2 * "
     "confidence margin: 0 = the model is saturated-confident, 1 = every "
     "pixel sits on the decision boundary -- the model has never seen "
@@ -251,31 +253,31 @@ MODEL_ANOMALY_SCORE = REGISTRY.histogram(
 # -- host-path ingest (serving/ingest.py) ------------------------------------
 
 DECODE_SECONDS = REGISTRY.histogram(
-    "rdp_decode_seconds",
+    families.DECODE_SECONDS,
     "Actual per-frame image-decode work (wherever it ran: decode worker "
     "or inline handler thread), by wire payload format (encoded = "
     "JPEG/PNG imdecode, raw = zero-copy frombuffer view, mixed).",
     ("format",),
 )
 DECODE_QUEUE_DEPTH = REGISTRY.gauge(
-    "rdp_decode_queue_depth",
+    families.DECODE_QUEUE_DEPTH,
     "Frames waiting in the decode worker pool's queue (0 with inline "
     "decode, ServerConfig.decode_workers = 0).",
 )
 GEOMETRY_CACHE_HITS = REGISTRY.counter(
-    "rdp_geometry_cache_hits_total",
+    families.GEOMETRY_CACHE_HITS,
     "Frames whose camera geometry (intrinsics + depth scale) was served "
     "from the per-stream geometry cache -- no per-frame float32 "
     "conversion, no re-staging.",
 )
 GEOMETRY_CACHE_MISSES = REGISTRY.counter(
-    "rdp_geometry_cache_misses_total",
+    families.GEOMETRY_CACHE_MISSES,
     "Geometry-cache misses (first sight of an intrinsics content / "
     "frame geometry / depth-scale combination; a stream changing "
     "intrinsics mid-stream misses into a fresh entry).",
 )
 HOST_STAGE_SPLIT = REGISTRY.histogram(
-    "rdp_host_stage_split_seconds",
+    families.HOST_STAGE_SPLIT,
     "Per-frame host/device split the --host-profile bench reads: decode "
     "(actual decode work), admit (submit to collected), stage_host "
     "(pooled-buffer fill), h2d (explicit device_put staging), launch "
@@ -287,61 +289,61 @@ HOST_STAGE_SPLIT = REGISTRY.histogram(
 # -- batching ----------------------------------------------------------------
 
 BATCH_QUEUE_DEPTH = REGISTRY.gauge(
-    "rdp_batch_queue_depth",
+    families.BATCH_QUEUE_DEPTH,
     "Frames waiting in the batch dispatcher's collector queue.",
 )
 BATCH_SIZE = REGISTRY.histogram(
-    "rdp_batch_size_frames",
+    families.BATCH_SIZE,
     "Frames coalesced into one batched device dispatch.",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128),
 )
 WATCHDOG_RESTARTS = REGISTRY.counter(
-    "rdp_batch_watchdog_restarts_total",
+    families.WATCHDOG_RESTARTS,
     "Times the watchdog restarted a dead batch collector/completer thread.",
 )
 INFLIGHT_DISPATCHES = REGISTRY.gauge(
-    "rdp_batch_inflight_dispatches",
+    families.INFLIGHT_DISPATCHES,
     "Batched dispatches launched on the device but not yet completed "
     "(bounded by ServerConfig.max_inflight_dispatches / RDP_INFLIGHT).",
 )
 DISPATCH_OVERLAP = REGISTRY.histogram(
-    "rdp_batch_overlap_seconds",
+    families.DISPATCH_OVERLAP,
     "Per-dispatch pipeline overlap: how long the previous dispatch was "
     "still completing (D2H + fan-out) after this one had already "
     "launched. Identically 0 in serial mode (max_inflight_dispatches=1).",
 )
 BATCH_STAGE_LATENCY = REGISTRY.histogram(
-    "rdp_batch_stage_seconds",
+    families.BATCH_STAGE_LATENCY,
     "Pipelined dispatcher stage latency: stage (host buffer fill + H2D), "
     "launch (async jit dispatch), complete (blocking D2H + fan-out).",
     ("stage",),
 )
 SERVING_CHIPS = REGISTRY.gauge(
-    "rdp_serving_chips",
+    families.SERVING_CHIPS,
     "Mesh chips the batch dispatcher routes dispatches across (1 = "
     "single-device dispatch).",
 )
 CHIP_DISPATCHES = REGISTRY.counter(
-    "rdp_chip_dispatches_total",
+    families.CHIP_DISPATCHES,
     "Batched dispatches launched, by mesh chip (chip '0' covers the "
     "single-device and data-sharded windows); the per-chip counts sum "
     "to the dispatcher's total.",
     ("chip",),
 )
 CHIP_FRAMES = REGISTRY.counter(
-    "rdp_chip_frames_total",
+    families.CHIP_FRAMES,
     "Frames carried by launched dispatches, by mesh chip (padding rows "
     "excluded).",
     ("chip",),
 )
 CHIP_INFLIGHT = REGISTRY.gauge(
-    "rdp_chip_inflight_dispatches",
+    families.CHIP_INFLIGHT,
     "Launched-but-not-completed dispatches per mesh chip; each chip's "
     "window is independently bounded by max_inflight_dispatches.",
     ("chip",),
 )
 BATCH_POOL_SIZE = REGISTRY.gauge(
-    "rdp_batch_pool_size",
+    families.BATCH_POOL_SIZE,
     "Free pooled host staging buffer sets across all bucket keys "
     "(capped per key at max_inflight * chips + 1; sustained growth "
     "here means a leak).",
@@ -350,7 +352,7 @@ BATCH_POOL_SIZE = REGISTRY.gauge(
 # -- overload control (serving/admission.py + serving/controller.py) ---------
 
 SHED_BY_DEADLINE = REGISTRY.counter(
-    "rdp_shed_by_deadline_total",
+    families.SHED_BY_DEADLINE,
     "Frames shed by deadline-aware admission, by shed point: 'evicted' "
     "(lost its backlog slot to a newer frame with more headroom), "
     "'stale' (deadline unmeetable given the per-frame service-time "
@@ -359,22 +361,22 @@ SHED_BY_DEADLINE = REGISTRY.counter(
     ("point",),
 )
 CONTROLLER_LEVEL = REGISTRY.gauge(
-    "rdp_controller_brownout_level",
+    families.CONTROLLER_LEVEL,
     "Reactive controller brownout ladder position: 0 normal, 1 batch "
     "window shrunk + in-flight window halved, 2 shedding earlier at "
     "admission, 3 refusing new streams.",
 )
 CONTROLLER_INFLIGHT = REGISTRY.gauge(
-    "rdp_controller_max_inflight",
+    families.CONTROLLER_INFLIGHT,
     "The in-flight-dispatch cap as currently tuned by the reactive "
     "controller (AIMD around ServerConfig.max_inflight_dispatches).",
 )
 CONTROLLER_WINDOW_MS = REGISTRY.gauge(
-    "rdp_controller_window_ms",
+    families.CONTROLLER_WINDOW_MS,
     "The batch window as currently tuned by the reactive controller.",
 )
 CONTROLLER_ACTIONS = REGISTRY.counter(
-    "rdp_controller_actions_total",
+    families.CONTROLLER_ACTIONS,
     "Reactive controller actions taken, by action (inflight_up, "
     "inflight_down, window_down, window_up, admission_tighten, "
     "admission_relax, refuse_streams, accept_streams, floor_up, "
@@ -385,18 +387,18 @@ CONTROLLER_ACTIONS = REGISTRY.counter(
 # -- chip quarantine (serving/batching.DeviceRouter) -------------------------
 
 QUARANTINED_CHIPS = REGISTRY.gauge(
-    "rdp_quarantined_chips",
+    families.QUARANTINED_CHIPS,
     "Mesh chips currently quarantined (removed from the dispatch ring "
     "by their per-chip circuit breaker; reinstated via half-open probe "
     "dispatches).",
 )
 CHIP_QUARANTINES = REGISTRY.counter(
-    "rdp_chip_quarantines_total",
+    families.CHIP_QUARANTINES,
     "Times each mesh chip entered quarantine.",
     ("chip",),
 )
 CHIP_FAILOVER_FRAMES = REGISTRY.counter(
-    "rdp_chip_failover_frames_total",
+    families.CHIP_FAILOVER_FRAMES,
     "Frames requeued onto healthy chips after their dispatch failed on "
     "a quarantining chip (each bounded to chips+1 attempts).",
 )
@@ -404,60 +406,60 @@ CHIP_FAILOVER_FRAMES = REGISTRY.counter(
 # -- serving fleet (serving/fleet.py + serving/frontend.py) ------------------
 
 FLEET_REPLICAS_LIVE = REGISTRY.gauge(
-    "rdp_fleet_replicas_live",
+    families.FLEET_REPLICAS_LIVE,
     "Replica servers currently placeable by the fleet front-end (health "
     "SERVING and replica breaker closed).",
 )
 FLEET_REPLICAS_QUARANTINED = REGISTRY.gauge(
-    "rdp_fleet_replicas_quarantined",
+    families.FLEET_REPLICAS_QUARANTINED,
     "Replicas held out of the placement ring by an open/half-open "
     "per-replica circuit breaker while their health endpoint still "
     "answers (stream-level failures quarantine faster than the health "
     "poll notices).",
 )
 FLEET_REPLICAS_DRAINING = REGISTRY.gauge(
-    "rdp_fleet_replicas_draining",
+    families.FLEET_REPLICAS_DRAINING,
     "Replicas reporting draining=true over the stats RPC: held out of "
     "NEW-stream placement while still healthy (graceful drain -- "
     "in-flight streams finish normally, nothing fails over), e.g. a "
     "rollout cycle borrowing the replica's chips for retraining.",
 )
 FLEET_REPLICA_STREAMS = REGISTRY.gauge(
-    "rdp_fleet_replica_streams",
+    families.FLEET_REPLICA_STREAMS,
     "Client streams the front-end currently has placed on each replica "
     "(the least-loaded pick's signal).",
     ("replica",),
 )
 FLEET_REPLICA_FRAMES = REGISTRY.counter(
-    "rdp_fleet_replica_frames_total",
+    families.FLEET_REPLICA_FRAMES,
     "Frames relayed through each replica by the fleet front-end.",
     ("replica",),
 )
 FLEET_REPLICA_BURN = REGISTRY.gauge(
-    "rdp_fleet_replica_burn",
+    families.FLEET_REPLICA_BURN,
     "Each replica's rdp_slo_error_budget_burn as last scraped over the "
     "replica stats RPC -- the fleet controller's rebalance signal.",
     ("replica",),
 )
 FLEET_REPLICA_WEIGHT = REGISTRY.gauge(
-    "rdp_fleet_replica_weight",
+    families.FLEET_REPLICA_WEIGHT,
     "Fleet-controller placement weight per replica (1.0 = full share; "
     "burning replicas decay toward ServerConfig.fleet_weight_floor).",
     ("replica",),
 )
 FLEET_PLACEMENTS = REGISTRY.counter(
-    "rdp_fleet_placements_total",
+    families.FLEET_PLACEMENTS,
     "New-stream placement decisions, by chosen replica.",
     ("replica",),
 )
 FLEET_FAILOVERS = REGISTRY.counter(
-    "rdp_fleet_failovers_total",
+    families.FLEET_FAILOVERS,
     "Stream-level replica failures the front-end handled (the stream was "
     "re-routed to another replica or its in-flight frames were "
     "error-completed).",
 )
 FLEET_FAILOVER_FRAMES = REGISTRY.counter(
-    "rdp_fleet_failover_frames_total",
+    families.FLEET_FAILOVER_FRAMES,
     "In-flight frames on a dead replica, by outcome: 'rerouted' (re-sent "
     "to a healthy replica under the caller's deadline) or "
     "'error_completed' (answered with an ERROR status -- never silently "
@@ -465,7 +467,7 @@ FLEET_FAILOVER_FRAMES = REGISTRY.counter(
     ("outcome",),
 )
 FLEET_CONTROLLER_ACTIONS = REGISTRY.counter(
-    "rdp_fleet_controller_actions_total",
+    families.FLEET_CONTROLLER_ACTIONS,
     "Fleet controller weight rebalances, by action (deweight, reweight).",
     ("action",),
 )
@@ -473,7 +475,7 @@ FLEET_CONTROLLER_ACTIONS = REGISTRY.counter(
 # -- fleet observability plane (observability/federation.py + journal.py) ----
 
 REPLICA_UP = REGISTRY.gauge(
-    "rdp_replica_up",
+    families.REPLICA_UP,
     "Per-replica scrape health on the front-end's federated metrics "
     "endpoint (GET /federate): 1 = this render scraped the replica's "
     "/metrics live, 0 = unreachable (its last good families are "
@@ -481,49 +483,47 @@ REPLICA_UP = REGISTRY.gauge(
     ("replica",),
 )
 REPLICA_SCRAPE_AGE = REGISTRY.gauge(
-    "rdp_replica_scrape_age_seconds",
+    families.REPLICA_SCRAPE_AGE,
     "Age of the newest /metrics+/debug/spans scrape the federator holds "
     "for each replica (staleness marker for dead or draining members; "
     "-1 = never scraped).",
     ("replica",),
 )
 REPLICA_DRAINING = REGISTRY.gauge(
-    "rdp_replica_draining",
+    families.REPLICA_DRAINING,
     "Per-replica draining flag as last scraped over the stats RPC "
     "(1 = healthy but out of new-stream placement; the aggregate count "
     "is rdp_fleet_replicas_draining).",
     ("replica",),
 )
 FLEET_BURN = REGISTRY.gauge(
-    "rdp_fleet_burn",
+    families.FLEET_BURN,
     "Fleet-level error-budget burn roll-up over the live replicas' "
     "scraped rdp_slo_error_budget_burn readings (stat = mean, max) -- "
     "the capacity planner's aggregate demand-vs-capacity signal.",
     ("stat",),
 )
 FLEET_FRAMES = REGISTRY.gauge(
-    "rdp_fleet_frames",
+    families.FLEET_FRAMES,
     "Total frames served across the fleet (sum of each replica's "
     "frames_total as last scraped over the stats RPC).",
 )
 FLEET_MODEL_ARRIVAL_RATE = REGISTRY.gauge(
-    "rdp_fleet_model_arrival_rate",
+    families.FLEET_MODEL_ARRIVAL_RATE,
     "Per-model arrival rate summed across replicas (frames/sec over "
     "each replica's ZooPlacer rate window) -- the capacity planner's "
     "fleet-wide per-model demand input.",
     ("model",),
 )
 JOURNAL_EVENTS = REGISTRY.counter(
-    "rdp_journal_events_total",
+    families.JOURNAL_EVENTS,
     "Structured events appended to the observability journal "
-    "(GET /debug/events), by kind: breaker.transition, chip.quarantine, "
-    "chip.reinstate, controller.action, fleet.membership, fleet.drain, "
-    "fleet.failover, rollout.transition, drift.recommendation, "
-    "watchdog.restart, zoo.rebalance, server.ready, server.drain.",
+    "(GET /debug/events), by kind -- the full vocabulary is "
+    "observability/events.py (events.ALL_KINDS).",
     ("kind",),
 )
 JOURNAL_DROPPED = REGISTRY.counter(
-    "rdp_journal_dropped_total",
+    families.JOURNAL_DROPPED,
     "Events the bounded journal ring evicted to make room (a consumer "
     "tailing /debug/events?since= sees the gap as a non-zero 'dropped' "
     "field; size the ring with RDP_JOURNAL_RING).",
@@ -533,17 +533,17 @@ JOURNAL_DROPPED = REGISTRY.counter(
 
 #: closed=0 / open=1 / half_open=2 (alert on `rdp_breaker_state == 1`).
 BREAKER_STATE = REGISTRY.gauge(
-    "rdp_breaker_state",
+    families.BREAKER_STATE,
     "Circuit breaker state: 0 closed, 1 open, 2 half-open.",
     ("breaker",),
 )
 BREAKER_TRANSITIONS = REGISTRY.counter(
-    "rdp_breaker_transitions_total",
+    families.BREAKER_TRANSITIONS,
     "Circuit breaker state transitions, by destination state.",
     ("breaker", "to"),
 )
 RETRIES = REGISTRY.counter(
-    "rdp_retry_attempts_total",
+    families.RETRIES,
     "Retry attempts (attempt N+1 scheduled after a transient failure), "
     "by call site.",
     ("site",),
@@ -552,7 +552,7 @@ RETRIES = REGISTRY.counter(
 # -- tracking ----------------------------------------------------------------
 
 HTTP_REQUESTS = REGISTRY.histogram(
-    "rdp_http_request_seconds",
+    families.HTTP_REQUESTS,
     "Tracking/registry HTTP round-trip latency, by outcome (one sample "
     "per attempt, retries included).",
     ("outcome",),
@@ -561,12 +561,12 @@ HTTP_REQUESTS = REGISTRY.histogram(
 # -- training ----------------------------------------------------------------
 
 TRAIN_STEP = REGISTRY.histogram(
-    "rdp_train_step_seconds",
+    families.TRAIN_STEP,
     "Mean optimizer-step wall time, observed once per epoch (whole-epoch "
     "scan dispatches have no per-step boundary to time).",
 )
 TRAIN_RATE = REGISTRY.gauge(
-    "rdp_train_examples_per_second",
+    families.TRAIN_RATE,
     "Training throughput over the last epoch's train phase.",
 )
 
@@ -583,7 +583,7 @@ def _on_breaker_transition(name: str, old: str | None, new: str) -> None:
         # per-replica fleet quarantine) is a journal event: an open
         # breaker IS the quarantine record incident reconstruction reads
         journal_lib.JOURNAL.append(
-            "breaker.transition", breaker=name, frm=old, to=new)
+            events.BREAKER_TRANSITION, breaker=name, frm=old, to=new)
 
 
 def _on_retry(site: str | None, attempt: int) -> None:

@@ -172,7 +172,8 @@ class EventJournal:
         }
 
 
-def _default_capacity() -> int:
+def _resolve_capacity() -> int:
+    """RDP_JOURNAL_RING resolver: ring size, unparsable falls back."""
     raw = os.environ.get("RDP_JOURNAL_RING", "").strip()
     try:
         return int(raw) if raw else 1024
@@ -182,4 +183,4 @@ def _default_capacity() -> int:
 
 #: The process-global journal every instrumented subsystem appends to and
 #: the exposition server's /debug/events reads.
-JOURNAL = EventJournal(_default_capacity())
+JOURNAL = EventJournal(_resolve_capacity())

@@ -223,7 +223,8 @@ class FlightRecorder:
         return {"spans": rows, "groups": groups, "timelines": len(seen)}
 
 
-def _default_capacity() -> int:
+def _resolve_capacity() -> int:
+    """RDP_SPAN_RING resolver: ring size, unparsable falls back."""
     raw = os.environ.get("RDP_SPAN_RING", "").strip()
     try:
         return int(raw) if raw else 256
@@ -232,4 +233,4 @@ def _default_capacity() -> int:
 
 
 #: The process-global recorder the dispatcher and exposition share.
-RECORDER = FlightRecorder(_default_capacity())
+RECORDER = FlightRecorder(_resolve_capacity())

@@ -62,7 +62,10 @@ _TP_RE = re.compile(
 )
 
 _current: contextvars.ContextVar["SpanContext | None"] = (
-    contextvars.ContextVar("rdp_trace_context", default=None)
+    # a contextvar name, not a metric family, despite the rdp_ prefix
+    contextvars.ContextVar(
+        "rdp_trace_context", default=None  # statecheck: disable=SC004
+    )
 )
 
 

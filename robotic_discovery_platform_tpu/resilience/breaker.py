@@ -81,6 +81,7 @@ class CircuitBreaker:
         self._failures = 0  # guarded_by: _lock
         self._opened_at = 0.0  # guarded_by: _lock
         self._probe_in_flight = False  # guarded_by: _lock
+        self._probe_started_at = 0.0  # guarded_by: _lock
         self._last_error: BaseException | None = None  # guarded_by: _lock
         _notify(self.name, None, self._state)
 
@@ -109,6 +110,15 @@ class CircuitBreaker:
             self._probe_in_flight = False
             log.info("circuit %r: open -> half_open (probing)", self.name)
             _notify(self.name, OPEN, HALF_OPEN)
+        elif (self._state == HALF_OPEN
+                and self._probe_in_flight
+                and self._clock() - self._probe_started_at
+                >= self.reset_timeout_s):
+            # the admitted probe never reported back (its caller died or
+            # hung): without this, half_open wedges forever because
+            # allow() admits at most one probe at a time. A dead probe
+            # is a failed probe -- re-open and retry on the next window.
+            self._trip("half-open probe timed out", None)
 
     def allow(self) -> bool:
         """True when a call may proceed now. In half-open state exactly one
@@ -119,6 +129,7 @@ class CircuitBreaker:
                 return True
             if self._state == HALF_OPEN and not self._probe_in_flight:
                 self._probe_in_flight = True
+                self._probe_started_at = self._clock()
                 return True
             return False
 

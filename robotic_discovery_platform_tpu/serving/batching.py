@@ -144,6 +144,7 @@ import numpy as np
 
 from robotic_discovery_platform_tpu.analysis.contracts import shape_contract
 from robotic_discovery_platform_tpu.observability import (
+    events,
     instruments as obs,
     journal as journal_lib,
     recorder as recorder_lib,
@@ -155,6 +156,9 @@ from robotic_discovery_platform_tpu.resilience import (
     CircuitBreaker,
     DeadlineExceeded,
     inject,
+)
+from robotic_discovery_platform_tpu.resilience import (
+    sites as fault_sites,
 )
 from robotic_discovery_platform_tpu.serving.admission import (
     DeadlineQueue,
@@ -408,7 +412,7 @@ class DeviceRouter:
             if reinstated:
                 obs.QUARANTINED_CHIPS.set(live)
                 journal_lib.JOURNAL.append(
-                    "chip.reinstate", chip=chip, quarantined=live)
+                    events.CHIP_REINSTATE, chip=chip, quarantined=live)
                 log.info("chip %d reinstated after successful probe "
                          "dispatch", chip)
                 if self.on_health is not None:
@@ -449,7 +453,7 @@ class DeviceRouter:
             obs.QUARANTINED_CHIPS.set(live)
             obs.CHIP_QUARANTINES.labels(chip=str(chip)).inc()
             journal_lib.JOURNAL.append(
-                "chip.quarantine", chip=chip, quarantined=live,
+                events.CHIP_QUARANTINE, chip=chip, quarantined=live,
                 error=str(exc) if exc is not None else "unknown",
             )
             log.error(
@@ -1036,7 +1040,7 @@ class BatchDispatcher:
                           f"{len(self._pending)} pending frame(s) failed",
                 )
                 journal_lib.JOURNAL.append(
-                    "watchdog.restart", stage=dead,
+                    events.WATCHDOG_RESTART, stage=dead,
                     pending=len(self._pending),
                 )
                 log.error(
@@ -1161,7 +1165,7 @@ class BatchDispatcher:
             # deliberately OUTSIDE the launch guard: an injected fault
             # here kills the collector thread itself, which is exactly the
             # failure mode the watchdog exists for
-            inject("serving.batch.collect")
+            inject(fault_sites.SERVING_BATCH_COLLECT)
             collected_ns = time.monotonic_ns()
             # group by (model, geometry): a dispatch is single-model by
             # construction, so one model's chip fault can only ever fail
@@ -1413,17 +1417,17 @@ class BatchDispatcher:
         bufs = None
         launched = False
         try:
-            inject("serving.batch.dispatch")
+            inject(fault_sites.SERVING_BATCH_DISPATCH)
             # per-chip fault site: RDP_FAULTS="serving.chip.1.dispatch:
             # exc:-1" (or the serving.chip.*.dispatch wildcard) kills or
             # slows exactly one chip's dispatches -- the quarantine and
             # failover drill, no code changes needed
-            inject(f"serving.chip.{chip}.dispatch")
+            inject(fault_sites.chip_dispatch(chip))
             # per-model fault site: kills exactly one zoo model's
             # dispatches (groups are single-model, so another model's
             # frames can never ride -- and never fail -- this launch);
             # the multimodel-smoke cross-model-isolation drill
-            inject(f"serving.model.{self._display_model(model)}.dispatch")
+            inject(fault_sites.model_dispatch(self._display_model(model)))
             n = len(group)
             obs.BATCH_SIZE.observe(n)
             self.recent_batch += 0.25 * (n - self.recent_batch)
@@ -1551,7 +1555,7 @@ class BatchDispatcher:
             pop_ns = time.monotonic_ns()
             t_pop = pop_ns / 1e9
             try:
-                inject("serving.batch.complete")
+                inject(fault_sites.SERVING_BATCH_COMPLETE)
                 # the ONE blocking host fetch, off the collector's critical
                 # path: batch N+1 is already staging/computing while this
                 # D2H + fan-out runs

@@ -27,6 +27,9 @@ from robotic_discovery_platform_tpu.io.frames import (
 )
 from robotic_discovery_platform_tpu.observability import trace
 from robotic_discovery_platform_tpu.resilience import RetryPolicy, inject
+from robotic_discovery_platform_tpu.resilience import (
+    sites as fault_sites,
+)
 from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
 from robotic_discovery_platform_tpu.utils.config import ClientConfig
 from robotic_discovery_platform_tpu.utils.logging import get_logger
@@ -174,7 +177,7 @@ def run_client(
     source.start()
 
     def stream_once():
-        inject("client.stream")
+        inject(fault_sites.CLIENT_STREAM)
         # one stream = one trace: the span's traceparent rides the call
         # metadata, the server adopts it, and both sides' log lines carry
         # the same [trace=...] stamp (a retried stream mints a new trace,

@@ -75,6 +75,7 @@ import time
 from typing import Any, Callable
 
 from robotic_discovery_platform_tpu.observability import (
+    events,
     instruments as obs,
     journal as journal_lib,
 )
@@ -237,7 +238,7 @@ class ReactiveController:
                 self._high_since = self._low_since = None
                 obs.CONTROLLER_ACTIONS.labels(action=action).inc()
                 journal_lib.JOURNAL.append(
-                    "controller.action", action=action,
+                    events.CONTROLLER_ACTION, action=action,
                     level=self.level, burn=round(burn, 3),
                 )
                 log.info("controller action: %s (burn %.2f, level %d)",
@@ -248,8 +249,17 @@ class ReactiveController:
         obs.CONTROLLER_LEVEL.set(self.level)
         return action
 
+    def _set_level(self, new: int) -> None:
+        """Every rung change is a control-plane transition: publish the
+        gauge and journal the move at the mutation site (not only once
+        per tick), so an incident reconstruction sees exactly when the
+        ladder moved and from where."""
+        old, self.level = self.level, new
+        obs.CONTROLLER_LEVEL.set(new)
+        journal_lib.JOURNAL.append(events.CONTROLLER_LEVEL, frm=old, to=new)
+
     def _escalate(self, d) -> str:
-        self.level += 1
+        self._set_level(self.level + 1)
         if self.level == 1:
             self._base_window_ms = d.window_ms
             self._base_inflight = d.max_inflight
@@ -263,21 +273,21 @@ class ReactiveController:
             self._refuse_streams(True)
             return "refuse_streams"
         # no stream-refusal hook: rung 3 degenerates to holding rung 2
-        self.level = 2
+        self._set_level(2)
         d.set_deadline_safety(3.0)
         return "admission_tighten"
 
     def _deescalate(self, d) -> str:
         if self.level == 3:
-            self.level = 2
+            self._set_level(2)
             if self._refuse_streams is not None:
                 self._refuse_streams(False)
             return "accept_streams"
         if self.level == 2:
-            self.level = 1
+            self._set_level(1)
             d.set_deadline_safety(1.0)
             return "admission_relax"
-        self.level = 0
+        self._set_level(0)
         if self._base_window_ms is not None:
             d.set_window_ms(self._base_window_ms)
         if self._base_inflight is not None:

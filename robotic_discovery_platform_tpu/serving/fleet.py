@@ -57,6 +57,7 @@ from typing import Callable
 import grpc
 
 from robotic_discovery_platform_tpu.observability import (
+    events,
     instruments as obs,
     journal as journal_lib,
 )
@@ -398,7 +399,7 @@ class FleetRouter:
                     "healthy" if healthy else exc,
                 )
                 journal_lib.JOURNAL.append(
-                    "fleet.membership",
+                    events.FLEET_MEMBERSHIP,
                     replica=r.endpoint,
                     state="joined" if r.placeable else "dropped",
                     reason="healthy" if healthy else str(exc),
@@ -439,7 +440,7 @@ class FleetRouter:
                 else "un-drained -- placeable again",
             )
             journal_lib.JOURNAL.append(
-                "fleet.drain", replica=r.endpoint,
+                events.FLEET_DRAIN, replica=r.endpoint,
                 state="draining" if r.draining else "undrained",
             )
         obs.FLEET_REPLICA_BURN.labels(replica=r.endpoint).set(r.burn)

@@ -67,6 +67,7 @@ from concurrent import futures
 import grpc
 
 from robotic_discovery_platform_tpu.observability import (
+    events,
     exposition,
     federation as federation_lib,
     journal as journal_lib,
@@ -543,7 +544,7 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
                             st, replica.endpoint, next_replica.endpoint,
                             n_pending, f"replica died ({code})")
                         journal_lib.JOURNAL.append(
-                            "fleet.failover", trace_id=st.trace_id,
+                            events.FLEET_FAILOVER, trace_id=st.trace_id,
                             frm=replica.endpoint,
                             to=next_replica.endpoint,
                             outcome="rerouted", frames=n_pending,
@@ -565,7 +566,7 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
                         st, replica.endpoint, "", n_pending,
                         f"replica died ({code}); no failover target")
                     journal_lib.JOURNAL.append(
-                        "fleet.failover", trace_id=st.trace_id,
+                        events.FLEET_FAILOVER, trace_id=st.trace_id,
                         frm=replica.endpoint, to="",
                         outcome="error_completed", frames=n_pending,
                         code=str(code),
@@ -594,7 +595,7 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
         died BETWEEN frames (nothing stranded, nothing re-sent), the
         stitched /debug/trace must show the hop."""
         tl = recorder_lib.Timeline(
-            "fleet.failover", labels={"frm": frm, "to": to or "-"})
+            events.FLEET_FAILOVER, labels={"frm": frm, "to": to or "-"})
         now = time.monotonic_ns()
         tl.span("failover", start_ns=now, end_ns=now,
                 trace_id=st.trace_id, frm=frm, to=to, frames=frames,

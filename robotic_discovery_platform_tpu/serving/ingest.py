@@ -71,11 +71,15 @@ from typing import Any, Callable, Iterable, Iterator
 import numpy as np
 
 from robotic_discovery_platform_tpu.observability import (
+    events,
     instruments as obs,
     journal as journal_lib,
     recorder as recorder_lib,
 )
 from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
+from robotic_discovery_platform_tpu.resilience import (
+    sites as fault_sites,
+)
 from robotic_discovery_platform_tpu.serving.proto import vision_pb2
 from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
@@ -372,7 +376,7 @@ class DecodePool:
         the host-split ``decode`` stage, and one ``ingest`` flight-
         recorder timeline whose ``decode`` span joins ``/debug/spans``."""
         t0 = time.monotonic_ns()
-        inject("serving.ingest.decode")
+        inject(fault_sites.SERVING_INGEST_DECODE)
         rgb, depth, fmt = decode_request(request)
         t1 = time.monotonic_ns()
         dt = (t1 - t0) / 1e9
@@ -452,7 +456,7 @@ class DecodePool:
                 return
             # deliberately OUTSIDE the per-frame guard: an injected fault
             # here kills the worker thread itself -- the watchdog drill
-            inject("serving.ingest.loop")
+            inject(fault_sites.SERVING_INGEST_LOOP)
             self._run_one(p)
 
     # -- watchdog -----------------------------------------------------------
@@ -478,7 +482,7 @@ class DecodePool:
                           f"{len(self._pending)} pending frame(s) failed",
                 )
                 journal_lib.JOURNAL.append(
-                    "watchdog.restart", stage="ingest",
+                    events.WATCHDOG_RESTART, stage="ingest",
                     workers=len(dead), pending=len(self._pending),
                 )
                 log.error(

@@ -68,6 +68,7 @@ from typing import Callable, NamedTuple, Sequence
 
 from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
 from robotic_discovery_platform_tpu.observability import (
+    events,
     instruments as obs,
     journal as journal_lib,
     recorder as recorder_lib,
@@ -569,7 +570,7 @@ class RolloutManager:
             **{k: str(v) for k, v in labels.items()},
         ))
         journal_lib.JOURNAL.append(
-            "rollout.transition", frm=frm, to=to,
+            events.ROLLOUT_TRANSITION, frm=frm, to=to,
             **{k: str(v) for k, v in labels.items()},
         )
         log.info("rollout: %s -> %s%s", frm, to,

@@ -124,6 +124,7 @@ from robotic_discovery_platform_tpu.io.frames import load_calibration
 from robotic_discovery_platform_tpu.models import variants as variants_lib
 from robotic_discovery_platform_tpu.monitoring import profile as profile_lib
 from robotic_discovery_platform_tpu.observability import (
+    events,
     exposition,
     instruments as obs,
     journal as journal_lib,
@@ -137,6 +138,9 @@ from robotic_discovery_platform_tpu.resilience import (
     CircuitOpenError,
     DeadlineExceeded,
     inject,
+)
+from robotic_discovery_platform_tpu.resilience import (
+    sites as fault_sites,
 )
 from robotic_discovery_platform_tpu.serving import (
     controller as controller_lib,
@@ -182,7 +186,7 @@ def resolve_serving_version(cfg: ServerConfig, store=None, *,
     pass a cached ``store`` -- rebuilding an MLflow-backed store every
     tick would churn clients and scratch dirs."""
     try:
-        inject("serving.resolve")
+        inject(fault_sites.SERVING_RESOLVE)
         store = store if store is not None else tracking.store_for(
             cfg.tracking_uri
         )
@@ -621,7 +625,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             reason=rec.reason,
         ))
         journal_lib.JOURNAL.append(
-            "drift.recommendation", rec.reason, model=model,
+            events.DRIFT_RECOMMENDATION, rec.reason, model=model,
             signals=",".join(rec.signals), generation=str(rec.generation),
         )
         log.warning("DRIFT[%s]: %s -- recommend retraining", model,
@@ -644,7 +648,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             reason=rec.reason,
         ))
         journal_lib.JOURNAL.append(
-            "drift.recommendation", rec.reason,
+            events.DRIFT_RECOMMENDATION, rec.reason,
             signals=",".join(rec.signals), generation=str(rec.generation),
         )
         log.warning(
@@ -1107,7 +1111,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                        model: str = ""):
         import cv2
 
-        inject("serving.analyze")
+        inject(fault_sites.SERVING_ANALYZE)
         timer = timer or StageTimer()
         h, w = rgb.shape[:2]
         # per-stream geometry cache: identical intrinsics content never
@@ -1857,7 +1861,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
     def mark_ready(self) -> None:
         self.health.set_all(health_lib.SERVING)
         journal_lib.JOURNAL.append(
-            "server.ready", version=str(self.current_version))
+            events.SERVER_READY, version=str(self.current_version))
 
     def drain(self, timeout_s: float | None = None) -> bool:
         """Begin graceful shutdown: flip readiness to NOT_SERVING, refuse
@@ -1872,7 +1876,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         if not already:
             self.health.set_all(health_lib.NOT_SERVING)
             journal_lib.JOURNAL.append(
-                "server.drain", streams=str(self.active_streams))
+                events.SERVER_DRAIN, streams=str(self.active_streams))
             log.info("draining: readiness down, waiting for %d in-flight "
                      "stream(s)", self.active_streams)
         deadline = time.monotonic() + timeout_s

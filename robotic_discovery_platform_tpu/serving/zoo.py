@@ -46,6 +46,7 @@ from typing import Any, Callable
 
 from robotic_discovery_platform_tpu.models import variants as variants_lib
 from robotic_discovery_platform_tpu.observability import (
+    events,
     instruments as obs,
     journal as journal_lib,
 )
@@ -373,7 +374,7 @@ class ZooPlacer:
         if changed:
             obs.ZOO_REBALANCES.inc()
             journal_lib.JOURNAL.append(
-                "zoo.rebalance", rebalance=n,
+                events.ZOO_REBALANCE, rebalance=n,
                 placement=";".join(
                     f"{m}:{','.join(map(str, cs))}"
                     for m, cs in sorted(placement.items())),

@@ -35,6 +35,9 @@ from robotic_discovery_platform_tpu.resilience import (
     RetryPolicy,
     inject,
 )
+from robotic_discovery_platform_tpu.resilience import (
+    sites as fault_sites,
+)
 from robotic_discovery_platform_tpu.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -45,19 +48,26 @@ _ARTIFACTS = "/api/2.0/mlflow-artifacts/artifacts"
 # Fault-injection site covering every HTTP round-trip this store makes
 # (tracking API calls and artifact proxy transfers alike); see
 # resilience/faults.py for the RDP_FAULTS spec grammar.
-FAULT_SITE = "tracking.rest.request"
+FAULT_SITE = fault_sites.TRACKING_REST_REQUEST
 
 
-def _default_retry() -> RetryPolicy:
-    """Transient HTTP failures (ConnectionError/timeout, 429, 5xx) retry
-    with jittered exponential backoff. Env-tunable so chaos tests (and
-    latency-sensitive deployments) reshape the schedule without code:
-    RDP_HTTP_RETRIES (attempts), RDP_HTTP_BACKOFF_S (base delay)."""
+def _resolve_retry() -> RetryPolicy:
+    """RDP_HTTP_RETRIES / RDP_HTTP_BACKOFF_S resolver. Transient HTTP
+    failures (ConnectionError/timeout, 429, 5xx) retry with jittered
+    exponential backoff; env-tunable so chaos tests (and latency-
+    sensitive deployments) reshape the schedule without code."""
     return RetryPolicy(
         max_attempts=int(os.environ.get("RDP_HTTP_RETRIES", "3")),
         base_delay_s=float(os.environ.get("RDP_HTTP_BACKOFF_S", "0.2")),
         max_delay_s=5.0,
     )
+
+
+def _resolve_deadline_s(timeout_s: float) -> float:
+    """RDP_HTTP_DEADLINE_S resolver: overall per-call budget including
+    retries; defaults to twice the single-request timeout."""
+    return float(os.environ.get("RDP_HTTP_DEADLINE_S",
+                                str(2.0 * timeout_s)))
 
 
 class MlflowRestError(RuntimeError):
@@ -83,10 +93,9 @@ class RestMlflowStore:
         # retries * timeout.
         self.deadline_s = (
             deadline_s if deadline_s is not None
-            else float(os.environ.get("RDP_HTTP_DEADLINE_S",
-                                      str(2.0 * timeout_s)))
+            else _resolve_deadline_s(timeout_s)
         )
-        self._retry = retry if retry is not None else _default_retry()
+        self._retry = retry if retry is not None else _resolve_retry()
         self._http = requests.Session()
         self._make_scratch()
 

@@ -409,6 +409,36 @@ CASES = [
                     s.release()
         """,
     ),
+    (
+        "JL014",  # RDP_* env knob read outside a resolve_* helper
+        """
+        import os
+
+        def capacity():
+            return int(os.environ.get("RDP_RING", "1024"))
+        """,
+        """
+        import os
+
+        def resolve_capacity():
+            return int(os.environ.get("RDP_RING", "1024"))
+        """,
+    ),
+    (
+        "JL014",  # subscript read and os.getenv both count
+        """
+        import os
+
+        def knob():
+            return os.environ["RDP_MODE"]
+        """,
+        """
+        import os
+
+        def _resolve_mode(default="off"):
+            return os.getenv("RDP_MODE", default)
+        """,
+    ),
 ]
 
 

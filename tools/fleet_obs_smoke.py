@@ -35,6 +35,12 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+# the central registries are the source of truth for family/kind names
+from robotic_discovery_platform_tpu.observability import (  # noqa: E402
+    events as event_kinds,
+    families,
+)
+
 
 def _get(port: int, path: str) -> str:
     with urllib.request.urlopen(
@@ -207,32 +213,33 @@ def main() -> int:
 
         # -- the federated scrape ----------------------------------------
         fed = _get(mport, "/federate")
-        if f'rdp_replica_up{{replica="{victim.endpoint}"}} 0' not in fed:
+        if f'{families.REPLICA_UP}{{replica="{victim.endpoint}"}} 0' not in fed:
             return _fail("dead replica not marked rdp_replica_up 0")
-        if f'rdp_replica_up{{replica="{survivor_ep}"}} 1' not in fed:
+        if f'{families.REPLICA_UP}{{replica="{survivor_ep}"}} 1' not in fed:
             return _fail("survivor not marked rdp_replica_up 1")
         survivor_samples = [ln for ln in fed.splitlines()
                             if f'replica="{survivor_ep}"' in ln]
         victim_samples = [ln for ln in fed.splitlines()
                           if f'replica="{victim.endpoint}"' in ln
-                          and ln.startswith("rdp_frames_total")]
-        if not any(ln.startswith("rdp_frames_total")
+                          and ln.startswith(families.FRAMES)]
+        if not any(ln.startswith(families.FRAMES)
                    for ln in survivor_samples):
             return _fail("survivor's samples missing from /federate")
         if not victim_samples:
             return _fail("victim's last-good families dropped from "
                          "/federate (staleness cache lost)")
-        if "rdp_fleet_frames" not in fed or "rdp_fleet_burn" not in fed:
+        if (families.FLEET_FRAMES not in fed
+                or families.FLEET_BURN not in fed):
             return _fail("fleet roll-up families missing from /federate")
 
         # -- the journal: quarantine -> failover in causal order ---------
         events = json.loads(
             _get(mport, f"/debug/events?since={cursor0}"))["events"]
         opened = [e for e in events
-                  if e["kind"] == "breaker.transition"
+                  if e["kind"] == event_kinds.BREAKER_TRANSITION
                   and e["attrs"].get("to") == "open"
                   and victim.endpoint in e["attrs"].get("breaker", "")]
-        failovers = [e for e in events if e["kind"] == "fleet.failover"]
+        failovers = [e for e in events if e["kind"] == event_kinds.FLEET_FAILOVER]
         if not opened:
             return _fail("no quarantine (breaker open) event for the "
                          "victim", events)
@@ -254,7 +261,7 @@ def main() -> int:
         events = json.loads(
             _get(mport, f"/debug/events?since={cursor0}"))["events"]
         rejoins = [e for e in events
-                   if e["kind"] == "fleet.membership"
+                   if e["kind"] == event_kinds.FLEET_MEMBERSHIP
                    and e["attrs"].get("replica") == victim.endpoint
                    and e["attrs"].get("state") == "joined"
                    and e["seq"] > failovers[0]["seq"]]

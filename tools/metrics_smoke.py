@@ -24,43 +24,50 @@ from pathlib import Path
 # runnable straight from a checkout, with or without `pip install -e .`
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+# the central registries (observability/families.py, events.py) are the
+# source of truth for every family/kind name -- never retype the strings
+from robotic_discovery_platform_tpu.observability import (  # noqa: E402
+    events,
+    families,
+)
+
 REQUIRED_FAMILIES = (
-    "rdp_frames_total",
-    "rdp_stage_latency_seconds",
-    "rdp_batch_queue_depth",
-    "rdp_breaker_state",
+    families.FRAMES,
+    families.STAGE_LATENCY,
+    families.BATCH_QUEUE_DEPTH,
+    families.BREAKER_STATE,
     # streaming-quantile summaries + SLO families (PR 6)
-    "rdp_stage_latency_summary_seconds",
-    "rdp_frame_latency_summary_seconds",
-    "rdp_slo_objective_seconds",
-    "rdp_slo_violations_total",
-    "rdp_slo_error_budget_burn",
+    families.STAGE_LATENCY_SUMMARY,
+    families.FRAME_LATENCY_SUMMARY,
+    families.SLO_OBJECTIVE,
+    families.SLO_VIOLATIONS,
+    families.SLO_BURN,
     # drift observability (PR 9)
-    "rdp_drift_score",
-    "rdp_drift_recommendations_total",
-    "rdp_drift_reference_age_seconds",
-    "rdp_model_confidence_margin",
-    "rdp_metrics_rows_skipped_total",
+    families.DRIFT_SCORE,
+    families.DRIFT_RECOMMENDATIONS,
+    families.DRIFT_REFERENCE_AGE,
+    families.MODEL_CONFIDENCE_MARGIN,
+    families.METRICS_ROWS_SKIPPED,
     # host-path ingest (PR 12)
-    "rdp_decode_seconds",
-    "rdp_decode_queue_depth",
-    "rdp_geometry_cache_hits_total",
-    "rdp_geometry_cache_misses_total",
-    "rdp_host_stage_split_seconds",
+    families.DECODE_SECONDS,
+    families.DECODE_QUEUE_DEPTH,
+    families.GEOMETRY_CACHE_HITS,
+    families.GEOMETRY_CACHE_MISSES,
+    families.HOST_STAGE_SPLIT,
     # model zoo (PR 14)
-    "rdp_zoo_models",
-    "rdp_model_dispatches_total",
-    "rdp_model_arrival_rate",
+    families.ZOO_MODELS,
+    families.MODEL_DISPATCHES,
+    families.MODEL_ARRIVAL_RATE,
     # fleet observability plane (PR 15): the journal counts events on
     # every server; the federation/roll-up families are declared
     # everywhere and populated on the front-end's /federate renders
-    "rdp_journal_events_total",
-    "rdp_journal_dropped_total",
-    "rdp_replica_up",
-    "rdp_replica_scrape_age_seconds",
-    "rdp_fleet_burn",
-    "rdp_fleet_frames",
-    "rdp_fleet_model_arrival_rate",
+    families.JOURNAL_EVENTS,
+    families.JOURNAL_DROPPED,
+    families.REPLICA_UP,
+    families.REPLICA_SCRAPE_AGE,
+    families.FLEET_BURN,
+    families.FLEET_FRAMES,
+    families.FLEET_MODEL_ARRIVAL_RATE,
 )
 #: every /debug endpoint the 404 help text must enumerate
 DEBUG_ENDPOINTS = (
@@ -84,27 +91,27 @@ DRIFT_SIGNALS = (
     "confidence_margin",
 )
 REQUIRED_SAMPLES = (
-    'rdp_stage_latency_seconds_count{stage="total"}',
-    'rdp_frames_total{status="',
-    'rdp_breaker_state{breaker="registry:',
-    'rdp_stage_latency_summary_seconds{stage="total",quantile="0.5"}',
-    'rdp_frame_latency_summary_seconds{quantile="0.99"}',
-    'rdp_slo_objective_seconds{objective="e2e"}',
+    f'{families.STAGE_LATENCY}_count{{stage="total"}}',
+    families.FRAMES + '{status="',
+    families.BREAKER_STATE + '{breaker="registry:',
+    f'{families.STAGE_LATENCY_SUMMARY}{{stage="total",quantile="0.5"}}',
+    f'{families.FRAME_LATENCY_SUMMARY}{{quantile="0.99"}}',
+    f'{families.SLO_OBJECTIVE}{{objective="e2e"}}',
     # the burn family carries a model label now (model="" = aggregate)
-    'rdp_slo_error_budget_burn{objective="e2e",model=""}',
+    f'{families.SLO_BURN}{{objective="e2e",model=""}}',
     # per-model labels on the hot families (multi-tenancy): every frame
     # is attributed to the zoo model that served it -- "seg" is the
     # default binary segmenter even on a single-model server
-    'rdp_zoo_models 1',
+    f"{families.ZOO_MODELS} 1",
     # every streamed frame observes its confidence margin
-    "rdp_model_confidence_margin_count",
+    f"{families.MODEL_CONFIDENCE_MARGIN}_count",
     # host-path ingest: every frame's decode work is measured and the
     # steady-state stream hits the geometry cache after its first frame
-    'rdp_decode_seconds_count{format="encoded"}',
-    'rdp_host_stage_split_seconds_count{stage="decode"}',
-    'rdp_host_stage_split_seconds_count{stage="encode"}',
+    f'{families.DECODE_SECONDS}_count{{format="encoded"}}',
+    f'{families.HOST_STAGE_SPLIT}_count{{stage="decode"}}',
+    f'{families.HOST_STAGE_SPLIT}_count{{stage="encode"}}',
     # the journal records readiness as a structured event on every boot
-    'rdp_journal_events_total{kind="server.ready"}',
+    f'{families.JOURNAL_EVENTS}{{kind="{events.SERVER_READY}"}}',
 )
 
 
@@ -230,8 +237,8 @@ def main() -> int:
         servicer.close()
 
     event_kinds = [e.get("kind") for e in events_payload.get("events", [])]
-    if "server.ready" not in event_kinds:
-        print(f"FAIL: /debug/events holds no server.ready event "
+    if events.SERVER_READY not in event_kinds:
+        print(f"FAIL: /debug/events holds no {events.SERVER_READY} event "
               f"(kinds: {event_kinds})")
         return 1
     if events_payload.get("next_cursor", 0) < 1:
@@ -267,11 +274,12 @@ def main() -> int:
     # per-model frame attribution: every rdp_frames_total sample names
     # the serving zoo model (default = "seg")
     frame_lines = [ln for ln in text.splitlines()
-                   if ln.startswith("rdp_frames_total{")]
+                   if ln.startswith(families.FRAMES + "{")]
     if not frame_lines:
-        missing.append("rdp_frames_total{...} samples")
+        missing.append(families.FRAMES + "{...} samples")
     elif not all('model="' in ln for ln in frame_lines):
-        missing.append('model="..." label on every rdp_frames_total sample')
+        missing.append(
+            f'model="..." label on every {families.FRAMES} sample')
     if missing:
         print("FAIL: /metrics is missing:")
         for m in missing:
@@ -281,7 +289,7 @@ def main() -> int:
         return 1
     # summary quantiles must be structurally monotone: exposition clamps
     # the independent P^2 estimators to non-decreasing order
-    q = quantile_values(text, "rdp_frame_latency_summary_seconds")
+    q = quantile_values(text, families.FRAME_LATENCY_SUMMARY)
     ladder = [q[k] for k in ("0.5", "0.95", "0.99", "0.999")]
     if ladder != sorted(ladder) or not all(v > 0 for v in ladder):
         print(f"FAIL: frame-latency quantiles not positive-monotone: {q}")
