@@ -24,6 +24,11 @@ drift-rec       a drift recommendation lands: one full rollout cycle runs
                 (candidate quality rotates good / gate-fail / promote-fail)
 stage-timeout   an admitted breaker probe is abandoned mid-flight (its
                 caller died) and a rollout cycle times out in DRAINING
+lease-register  elastic member replica-c registers (or re-registers) its
+                lease with the front-end's registry and joins the probe set
+lease-expire    replica-c's lease deadline is rewound to NOW; the sweep
+                takes the expiry edge and the member quarantines
+lease-leave     replica-c sends Leave: graceful drain, not expiry
 ==============  =============================================================
 
 Safety invariants, checked after EVERY event of every schedule:
@@ -35,16 +40,22 @@ Safety invariants, checked after EVERY event of every schedule:
 - breaker-honest: at/over the failure threshold with no success since,
   the breaker is not CLOSED
 - last-chip: the device router never quarantines its last healthy chip
+- lease-honest: a member whose lease is expired or left is never
+  placeable (quarantined / draining, NOT silently kept in the ring),
+  and an expired/left member is never dropped from the replica list
+  (quarantine is recoverable; prune is far beyond the depth bound)
 
 Recurrence, checked at every schedule leaf: after the excursion ends
-(failures stop, replicas return, clocks advance), the rollout machine is
-IDLE, the standalone breaker re-closes, the brownout ladder returns to
-level 0, and every fleet replica is placeable again.
+(failures stop, replicas return, leased members re-register, clocks
+advance), the rollout machine is IDLE, the standalone breaker
+re-closes, the brownout ladder returns to level 0, and every fleet
+replica -- static seed or leased member -- is placeable again.
 
 Transition coverage ties the two halves together: the edges this
 explorer WITNESSES are compared against the edges statecheck EXTRACTS
-from rollout.py and breaker.py -- a dead edge in the source or a
-schedule hole in the explorer both surface as missing coverage.
+from rollout.py, breaker.py, and fleet.py (the lease machine) -- a dead
+edge in the source or a schedule hole in the explorer both surface as
+missing coverage.
 
 Run: ``python -m robotic_discovery_platform_tpu.analysis.explore
 --depth 4 --require-full-coverage``.
@@ -86,6 +97,9 @@ EVENTS = (
     "replica-rejoin",
     "drift-rec",
     "stage-timeout",
+    "lease-register",
+    "lease-expire",
+    "lease-leave",
 )
 
 TICK_S = 3.0
@@ -99,6 +113,7 @@ ROLLOUT_SRC = _REPO_ROOT / "robotic_discovery_platform_tpu/serving/rollout.py"
 BREAKER_SRC = (
     _REPO_ROOT / "robotic_discovery_platform_tpu/resilience/breaker.py"
 )
+FLEET_SRC = _REPO_ROOT / "robotic_discovery_platform_tpu/serving/fleet.py"
 
 
 class InvariantViolation(AssertionError):
@@ -301,11 +316,14 @@ class World:
     """One fresh copy of the control plane, every clock injectable."""
 
     ENDPOINTS = ("replica-a:1", "replica-b:1")
+    #: the elastic member: joins by lease, never in the static seed list
+    LEASED = "replica-c:1"
 
     def __init__(self):
         self.clock = FakeClock()
         self.breaker_edges: set[tuple[str, str]] = set()
         self.rollout_edges: set[tuple[str, str]] = set()
+        self.lease_edges: set[tuple[str, str]] = set()
 
         # standalone breaker: the explored per-dependency instance
         self.breaker = breaker_lib.CircuitBreaker(
@@ -347,16 +365,21 @@ class World:
         self.cycles: list[dict] = []
         self.fail_count = 0
 
-        # fleet membership over fake transport
+        # fleet membership over fake transport, with an elastic lease
+        # registry riding along: TTL far above the schedule horizon so
+        # the ONLY expiries are the deterministic lease-expire event's
+        # (force_expire + the sweep's honest clocked edge)
         self.replica_up = {ep: True for ep in self.ENDPOINTS}
+        self.replica_up[self.LEASED] = True
+        self.leases = fleet_lib.LeaseRegistry(ttl_s=1000.0,
+                                              clock=self.clock)
         self.fleet = fleet_lib.FleetRouter(
             list(self.ENDPOINTS), breaker_failures=FAILURE_THRESHOLD,
             breaker_reset_s=BREAKER_RESET_S, clock=self.clock,
             channel_factory=lambda ep: None,
+            registry=self.leases,
         )
-        for r in self.fleet.replicas:
-            r._health_stub = FakeHealthStub(self, r.endpoint)
-            r._stats_stub = FakeStatsStub(self, r.endpoint)
+        self._seed_stubs()
 
         # chip quarantine over a fake 2-chip mesh
         self.router = batching_lib.DeviceRouter(
@@ -364,6 +387,15 @@ class World:
             breaker_failures=FAILURE_THRESHOLD,
             breaker_reset_s=BREAKER_RESET_S, clock=self.clock,
         )
+
+    def _seed_stubs(self) -> None:
+        """Fake transport onto every replica that lacks it (the statics
+        at construction; the leased member each time sync_leases admits
+        it)."""
+        for r in self.fleet.replicas:
+            if r._health_stub is None:
+                r._health_stub = FakeHealthStub(self, r.endpoint)
+                r._stats_stub = FakeStatsStub(self, r.endpoint)
 
     # -- event semantics -----------------------------------------------------
 
@@ -376,6 +408,9 @@ class World:
             "replica-rejoin": self._ev_replica_rejoin,
             "drift-rec": self._ev_drift_rec,
             "stage-timeout": self._ev_stage_timeout,
+            "lease-register": self._ev_lease_register,
+            "lease-expire": self._ev_lease_expire,
+            "lease-leave": self._ev_lease_leave,
         }[event]
         handler()
 
@@ -438,6 +473,26 @@ class World:
             RuntimeError("registry unreachable") if variant == 2 else None)
         self.cycles.append(self.rollout.run_cycle(_FakeRec()))
 
+    def _ev_lease_register(self) -> None:
+        # idempotent for an active lease (refresh); the re-register
+        # after lease-expire / lease-leave takes the * -> active edge
+        self.replica_up[self.LEASED] = True
+        self.leases.register(self.LEASED)
+        self.fleet.sync_leases()
+        self._seed_stubs()
+        self.fleet.poll_once()
+
+    def _ev_lease_expire(self) -> None:
+        # rewind the deadline; the sweep inside poll_once takes the
+        # honest clocked active -> expired edge and the member drops out
+        # through the forced-probe-failure path (quarantine, not removal)
+        self.leases.force_expire(self.LEASED)
+        self.fleet.poll_once()
+
+    def _ev_lease_leave(self) -> None:
+        self.leases.leave(self.LEASED)
+        self.fleet.poll_once()
+
     def _ev_stage_timeout(self) -> None:
         # an admitted breaker probe is abandoned: its caller died before
         # reporting an outcome. The stream it carried is answered-with-
@@ -482,10 +537,29 @@ class World:
         if len(self.router._quarantined) >= len(self.router.ring):
             fail("last-chip",
                  f"all chips quarantined: {self.router._quarantined}")
+        members = {r.endpoint: r for r in self.fleet.replicas}
+        for ep, lease in self.leases.snapshot().items():
+            if ep not in members:
+                fail("lease-honest",
+                     f"leased member {ep} ({lease['state']}) dropped "
+                     "from the replica list (quarantine is recoverable, "
+                     "removal is not)")
+            if (lease["state"] != fleet_lib.LEASE_ACTIVE
+                    and members[ep].placeable):
+                fail("lease-honest",
+                     f"{ep} placeable with lease {lease['state']!r}")
 
     def check_recurrence(self, trace: tuple) -> None:
         """From any leaf, ending the excursion re-arms everything."""
         self.replica_up.update((ep, True) for ep in self.ENDPOINTS)
+        self.replica_up[self.LEASED] = True
+        # a healthy elastic member re-registers whenever its renew is
+        # refused (LeaseClient's fallback), so re-arm does the same for
+        # every lease the schedule touched
+        for ep in self.leases.endpoints():
+            self.leases.register(ep)
+        self.fleet.sync_leases()
+        self._seed_stubs()
         self.burn = 0.1
         for _ in range(4):  # > reset timeout + sustain + cooldown
             self._ev_tick()
@@ -526,6 +600,9 @@ class World:
             self.cycles[-1]["outcome"] if self.cycles else None,
             tuple(sorted(self.replica_up.items())),
             tuple(r.placeable for r in self.fleet.replicas),
+            tuple(sorted(
+                (ep, lease["state"])
+                for ep, lease in self.leases.snapshot().items())),
             tuple(sorted(self.router._quarantined)),
             self.consec_fails,
         )
@@ -569,6 +646,7 @@ def run(depth: int = 4, seed: int = 0, *,
     schedules = 0
 
     observer_restore = breaker_lib._observer
+    lease_observer_restore = fleet_lib._lease_observer
     holder: dict = {"world": None}
 
     def observe(name, old, new):
@@ -576,9 +654,16 @@ def run(depth: int = 4, seed: int = 0, *,
         if w is not None and old is not None:
             w.breaker_edges.add((old, new))
 
+    def observe_lease(endpoint, frm, to):
+        w = holder["world"]
+        if w is not None:
+            w.lease_edges.add((frm, to))
+
     breaker_lib.set_observer(observe)
+    fleet_lib.set_lease_observer(observe_lease)
     all_breaker_edges: set = set()
     all_rollout_edges: set = set()
+    all_lease_edges: set = set()
     try:
         stack = [()]
         while stack:
@@ -591,9 +676,11 @@ def run(depth: int = 4, seed: int = 0, *,
                 if holder["world"] is not None:
                     all_breaker_edges |= holder["world"].breaker_edges
                     all_rollout_edges |= holder["world"].rollout_edges
+                    all_lease_edges |= holder["world"].lease_edges
                 continue
             all_breaker_edges |= world.breaker_edges
             all_rollout_edges |= world.rollout_edges
+            all_lease_edges |= world.lease_edges
             key = world.state_key()
             if prefix and key in visited:
                 continue  # converged with an already-explored world
@@ -607,11 +694,13 @@ def run(depth: int = 4, seed: int = 0, *,
                         violations.append(str(exc))
                     all_breaker_edges |= world.breaker_edges
                     all_rollout_edges |= world.rollout_edges
+                    all_lease_edges |= world.lease_edges
                 continue
             for ev in reversed(alphabet):
                 stack.append(prefix + (ev,))
     finally:
         breaker_lib.set_observer(observer_restore)
+        fleet_lib.set_lease_observer(lease_observer_restore)
         holder["world"] = None
 
     coverage = {
@@ -619,6 +708,8 @@ def run(depth: int = 4, seed: int = 0, *,
                                     all_rollout_edges),
         "breaker._state": _coverage(BREAKER_SRC, "_state",
                                     all_breaker_edges),
+        "fleet._state": _coverage(FLEET_SRC, "_state",
+                                  all_lease_edges),
     }
     return {
         "depth": depth,

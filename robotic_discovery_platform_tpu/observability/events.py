@@ -32,6 +32,9 @@ CONTROLLER_ACTION = "controller.action"
 CONTROLLER_LEVEL = "controller.level"
 #: the rollout state machine moved (idle -> draining -> ... -> idle)
 ROLLOUT_TRANSITION = "rollout.transition"
+#: a RETRAINING stage blew its timeout and the manager actively
+#: cancelled the training job (cooperative cancel flag)
+ROLLOUT_RETRAIN_CANCEL = "rollout.retrain_cancel"
 #: a chip's quarantine breaker opened: the chip left the dispatch ring
 CHIP_QUARANTINE = "chip.quarantine"
 #: a quarantined chip's half-open probe succeeded: back in the ring
@@ -50,6 +53,15 @@ FLEET_MEMBERSHIP = "fleet.membership"
 #: a replica's graceful-drain flag flipped (stays healthy, leaves
 #: placement)
 FLEET_DRAIN = "fleet.drain"
+#: a membership lease moved (register / renew-refused / active ->
+#: expired / active -> left) -- the elastic fleet's join/leave record
+FLEET_LEASE = "fleet.lease"
+#: the capacity planner emitted a (replicas, chips, precision,
+#: dispatch-mode, window) plan for the current demand
+PLANNER_PLAN = "planner.plan"
+#: the autoscaler acted on a plan (scale_up / scale_down) or refused to
+#: (cooldown, bounds)
+AUTOSCALER_ACTION = "autoscaler.action"
 
 # -- lifecycle / drift -------------------------------------------------------
 

@@ -26,7 +26,9 @@ down with it. It serves:
   (observability/journal.py): breaker/quarantine transitions, controller
   and rollout actions, drift recommendations, watchdog restarts, fleet
   membership and failover decisions, in causal order with a monotonic
-  resume cursor;
+  resume cursor. On the fleet front-end an installed
+  :meth:`MetricsServer.set_events_provider` overrides this with the
+  fleet-wide aggregation (own journal merged with every member's);
 - ``GET /debug/drift`` -- the online drift monitor's state as JSON
   (live vs reference histograms, per-signal PSI/JS scores, the
   recommendation ladder; monitoring/profile.py). The serving layer
@@ -153,6 +155,10 @@ class MetricsServer:
         # federator behind /federate (observability/federation.py)
         self._trace_provider = None
         self._federation_provider = None
+        # (since) -> dict override for /debug/events: the front-end
+        # installs its fleet-wide journal aggregation here; without one
+        # the endpoint serves this process's own journal
+        self._events_provider = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -202,7 +208,11 @@ class MetricsServer:
                             {"error": f"bad since cursor {raw!r}"},
                             status=400)
                         return
-                    self._send_json(outer._journal.snapshot(since))
+                    provider = outer._events_provider
+                    if provider is not None:
+                        self._send_json(provider(since))
+                    else:
+                        self._send_json(outer._journal.snapshot(since))
                 elif path == "/debug/drift":
                     provider = outer._drift_provider
                     if provider is None:
@@ -326,6 +336,14 @@ class MetricsServer:
         callable taking one trace ID and returning a JSON-able dict (the
         fleet front-end's cross-host stitched view)."""
         self._trace_provider = provider
+
+    def set_events_provider(self, provider) -> None:
+        """Install (or clear) a ``GET /debug/events`` override: a
+        callable taking the ``since`` cursor and returning a JSON-able
+        dict. The fleet front-end installs its fleet-wide aggregation
+        (own journal merged with every member's) here; cleared, the
+        endpoint serves the process-local journal."""
+        self._events_provider = provider
 
     def set_federation_provider(self, provider) -> None:
         """Install (or clear) the ``GET /federate`` payload source: a

@@ -37,6 +37,7 @@ ROLLOUT_GATE_VERDICTS = "rdp_rollout_gate_verdicts_total"
 ROLLOUT_ROLLBACKS = "rdp_rollout_rollbacks_total"
 ROLLOUT_CYCLES = "rdp_rollout_cycles_total"
 ROLLOUT_SKIPPED = "rdp_rollout_skipped_total"
+ROLLOUT_RETRAIN_CANCELS = "rdp_rollout_retrain_cancels_total"
 ZOO_MODELS = "rdp_zoo_models"
 MODEL_ARRIVAL_RATE = "rdp_model_arrival_rate"
 MODEL_CHIPS = "rdp_model_chips"
@@ -78,6 +79,14 @@ FLEET_PLACEMENTS = "rdp_fleet_placements_total"
 FLEET_FAILOVERS = "rdp_fleet_failovers_total"
 FLEET_FAILOVER_FRAMES = "rdp_fleet_failover_frames_total"
 FLEET_CONTROLLER_ACTIONS = "rdp_fleet_controller_actions_total"
+FLEET_LEASE_MEMBERS = "rdp_fleet_lease_members"
+FLEET_LEASE_TRANSITIONS = "rdp_fleet_lease_transitions_total"
+FLEET_LEASE_REGISTRATIONS = "rdp_fleet_lease_registrations_total"
+FLEET_LEASE_RENEWALS = "rdp_fleet_lease_renewals_total"
+FLEET_LEASE_EXPIRIES = "rdp_fleet_lease_expiries_total"
+PLANNER_PLANS = "rdp_planner_plans_total"
+PLANNER_TARGET_REPLICAS = "rdp_planner_target_replicas"
+AUTOSCALER_ACTIONS = "rdp_autoscaler_actions_total"
 REPLICA_UP = "rdp_replica_up"
 REPLICA_SCRAPE_AGE = "rdp_replica_scrape_age_seconds"
 REPLICA_DRAINING = "rdp_replica_draining"
@@ -86,6 +95,8 @@ FLEET_FRAMES = "rdp_fleet_frames"
 FLEET_MODEL_ARRIVAL_RATE = "rdp_fleet_model_arrival_rate"
 JOURNAL_EVENTS = "rdp_journal_events_total"
 JOURNAL_DROPPED = "rdp_journal_dropped_total"
+JOURNAL_PERSISTED = "rdp_journal_persisted_total"
+JOURNAL_PERSIST_ERRORS = "rdp_journal_persist_errors_total"
 BREAKER_STATE = "rdp_breaker_state"
 BREAKER_TRANSITIONS = "rdp_breaker_transitions_total"
 RETRIES = "rdp_retry_attempts_total"

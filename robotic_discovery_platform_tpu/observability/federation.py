@@ -157,6 +157,7 @@ def merge_exposition(families: dict[str, _Family]) -> str:
 class _CacheEntry(NamedTuple):
     metrics_text: str | None
     spans: dict | None
+    events: dict | None
     unix_ts: float
 
 
@@ -199,7 +200,15 @@ class FleetFederator:
         except Exception as exc:  # noqa: BLE001 - any transport failure
             log.debug("federation scrape of %s failed: %s", t.replica, exc)
             return None
-        entry = _CacheEntry(text, spans, time.time())
+        # the journal ride-along is separately best-effort so a member
+        # without /debug/events still federates metrics + spans
+        try:
+            events_payload = json.loads(
+                self._fetch(f"{t.base_url}/debug/events", self.timeout_s))
+        except Exception as exc:  # noqa: BLE001
+            log.debug("journal scrape of %s failed: %s", t.replica, exc)
+            events_payload = None
+        entry = _CacheEntry(text, spans, events_payload, time.time())
         with self._lock:
             self._cache[t.replica] = entry
         return entry
@@ -221,6 +230,28 @@ class FleetFederator:
                 out.append((t, None, -1.0, False))
             else:
                 out.append((t, entry.spans,
+                            round(now - entry.unix_ts, 3), fresh))
+        return out
+
+    def journal_payloads(self) -> list[tuple[ScrapeTarget, dict | None,
+                                             float, bool]]:
+        """Per replica: (target, /debug/events payload or None, age_s,
+        fresh) -- same live-then-last-good discipline as
+        :meth:`span_payloads`. The front-end's fleet-wide
+        ``/debug/events`` aggregation reads this: a SIGKILLed member's
+        final journal entries survive it in the merged view."""
+        out = []
+        now = time.time()
+        for t in self._targets():
+            entry = self._scrape(t)
+            fresh = entry is not None
+            if entry is None:
+                with self._lock:
+                    entry = self._cache.get(t.replica)
+            if entry is None:
+                out.append((t, None, -1.0, False))
+            else:
+                out.append((t, entry.events,
                             round(now - entry.unix_ts, 3), fresh))
         return out
 

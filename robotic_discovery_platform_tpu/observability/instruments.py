@@ -206,6 +206,12 @@ ROLLOUT_SKIPPED = REGISTRY.counter(
     "availability for freshness).",
     ("reason",),
 )
+ROLLOUT_RETRAIN_CANCELS = REGISTRY.counter(
+    families.ROLLOUT_RETRAIN_CANCELS,
+    "RETRAINING stages the manager actively cancelled after they blew "
+    "RolloutConfig.retrain_timeout_s (cooperative cancel flag threaded "
+    "through workflows/retraining -- the job stops, not just the wait).",
+)
 
 # -- model zoo + statistical multiplexing (serving/zoo.py) -------------------
 
@@ -472,6 +478,59 @@ FLEET_CONTROLLER_ACTIONS = REGISTRY.counter(
     ("action",),
 )
 
+# -- elastic membership (serving/fleet.py lease registry) --------------------
+
+FLEET_LEASE_MEMBERS = REGISTRY.gauge(
+    families.FLEET_LEASE_MEMBERS,
+    "Membership leases the front-end's registry currently holds, by "
+    "lease state (active / expired / left). Static RDP_FLEET_REPLICAS "
+    "seeds never appear here.",
+    ("state",),
+)
+FLEET_LEASE_TRANSITIONS = REGISTRY.counter(
+    families.FLEET_LEASE_TRANSITIONS,
+    "Lease state-machine transitions, by destination state (expired = "
+    "missed TTL renewals, the breaker drop-out path; left = graceful "
+    "Leave, the PR 13 drain path; active = re-register after either).",
+    ("state",),
+)
+FLEET_LEASE_REGISTRATIONS = REGISTRY.counter(
+    families.FLEET_LEASE_REGISTRATIONS,
+    "Register RPCs accepted (fresh endpoints and re-registrations of "
+    "expired/left/double-registered ones).",
+)
+FLEET_LEASE_RENEWALS = REGISTRY.counter(
+    families.FLEET_LEASE_RENEWALS,
+    "Renew RPCs that extended an active lease (a renew that loses the "
+    "race with expiry is refused and counts as an expiry, not here).",
+)
+FLEET_LEASE_EXPIRIES = REGISTRY.counter(
+    families.FLEET_LEASE_EXPIRIES,
+    "Leases the TTL sweep expired (member stopped renewing: SIGKILL, "
+    "partition, or wedged renew loop).",
+)
+
+# -- capacity planner / autoscaler (serving/planner.py) ----------------------
+
+PLANNER_PLANS = REGISTRY.counter(
+    families.PLANNER_PLANS,
+    "Capacity plans emitted, by the planner's recommendation relative "
+    "to the current fleet (scale_up, scale_down, hold).",
+    ("recommendation",),
+)
+PLANNER_TARGET_REPLICAS = REGISTRY.gauge(
+    families.PLANNER_TARGET_REPLICAS,
+    "Replica count the newest capacity plan asked for (the cheapest "
+    "config meeting the SLO at the observed arrival rate).",
+)
+AUTOSCALER_ACTIONS = REGISTRY.counter(
+    families.AUTOSCALER_ACTIONS,
+    "Autoscaler actions actually taken (scale_up = spawn a "
+    "self-registering replica, scale_down = drain the least-loaded "
+    "member) or refused (hold_cooldown, hold_bounds, hold_sustain).",
+    ("action",),
+)
+
 # -- fleet observability plane (observability/federation.py + journal.py) ----
 
 REPLICA_UP = REGISTRY.gauge(
@@ -527,6 +586,17 @@ JOURNAL_DROPPED = REGISTRY.counter(
     "Events the bounded journal ring evicted to make room (a consumer "
     "tailing /debug/events?since= sees the gap as a non-zero 'dropped' "
     "field; size the ring with RDP_JOURNAL_RING).",
+)
+JOURNAL_PERSISTED = REGISTRY.counter(
+    families.JOURNAL_PERSISTED,
+    "Events appended to the RDP_JOURNAL_PATH JSONL file (the SIGKILL "
+    "post-mortem record; rotation bounded by "
+    "RDP_JOURNAL_ROTATE_BYTES).",
+)
+JOURNAL_PERSIST_ERRORS = REGISTRY.counter(
+    families.JOURNAL_PERSIST_ERRORS,
+    "Journal file appends that failed (persistence is best-effort: the "
+    "in-memory ring and /debug/events stay authoritative).",
 )
 
 # -- resilience --------------------------------------------------------------
@@ -603,6 +673,10 @@ def install_journal_hooks() -> None:
     journal_lib.set_observer(
         lambda kind: JOURNAL_EVENTS.labels(kind=kind).inc(),
         lambda n: JOURNAL_DROPPED.inc(n),
+    )
+    journal_lib.set_persist_observer(
+        lambda n: JOURNAL_PERSISTED.inc(n),
+        lambda n: JOURNAL_PERSIST_ERRORS.inc(n),
     )
 
 

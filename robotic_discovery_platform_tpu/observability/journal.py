@@ -32,6 +32,7 @@ kind, ``rdp_journal_dropped_total``).
 from __future__ import annotations
 
 import itertools
+import json
 import os
 import time
 from collections import deque
@@ -45,6 +46,8 @@ from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 #: module never imports the metrics registry)
 _on_event: Callable[[str], None] | None = None
 _on_drop: Callable[[int], None] | None = None
+_on_persist: Callable[[int], None] | None = None
+_on_persist_error: Callable[[int], None] | None = None
 
 
 def set_observer(on_event: Callable[[str], None] | None,
@@ -52,6 +55,14 @@ def set_observer(on_event: Callable[[str], None] | None,
     global _on_event, _on_drop
     _on_event = on_event
     _on_drop = on_drop
+
+
+def set_persist_observer(
+        on_persist: Callable[[int], None] | None,
+        on_error: Callable[[int], None] | None = None) -> None:
+    global _on_persist, _on_persist_error
+    _on_persist = on_persist
+    _on_persist_error = on_error
 
 
 @dataclass(frozen=True)
@@ -82,12 +93,52 @@ class Event:
         }
 
 
+class JournalFile:
+    """Best-effort JSONL sink for the journal (``RDP_JOURNAL_PATH``):
+    each event appended as one JSON line so a SIGKILLed member's journal
+    survives on disk for post-mortem merge (``tools/journal_tail.py``).
+    Rotation is single-generation and bounded: when the file would
+    exceed ``rotate_bytes`` it is renamed to ``<path>.1`` (replacing any
+    previous generation) and a fresh file starts -- worst case
+    ~2x rotate_bytes on disk. Failures count, never raise: the
+    in-memory ring stays authoritative."""
+
+    def __init__(self, path: str, rotate_bytes: int = 4 * 1024 * 1024):
+        self.path = str(path)
+        self.rotate_bytes = max(4096, int(rotate_bytes))
+        self._lock = checked_lock("journal.file")
+        try:
+            self._size = os.path.getsize(self.path)  # guarded_by: _lock
+        except OSError:
+            self._size = 0
+
+    def write(self, event: Event) -> bool:
+        line = json.dumps(event.to_dict(), sort_keys=True) + "\n"
+        data = line.encode("utf-8")
+        try:
+            with self._lock:
+                if self._size and self._size + len(data) > self.rotate_bytes:
+                    os.replace(self.path, self.path + ".1")
+                    self._size = 0
+                with open(self.path, "ab") as f:
+                    f.write(data)
+                self._size += len(data)
+        except OSError:
+            if _on_persist_error is not None:
+                _on_persist_error(1)
+            return False
+        if _on_persist is not None:
+            _on_persist(1)
+        return True
+
+
 class EventJournal:
     """Bounded, append-only, thread-safe event log with a monotonic
     cursor. ``append`` is what every instrumented control-plane site
     calls; readers tail with :meth:`events_since` / :meth:`snapshot`."""
 
-    def __init__(self, capacity: int = 1024):
+    def __init__(self, capacity: int = 1024,
+                 sink: JournalFile | None = None):
         self._capacity = max(1, int(capacity))
         self._lock = checked_lock("journal.events")
         self._events: deque[Event] = deque(
@@ -95,6 +146,10 @@ class EventJournal:
         self._seq = itertools.count()  # guarded_by: _lock
         self._dropped = 0  # guarded_by: _lock
         self._enabled = True
+        self._sink = sink
+
+    def set_sink(self, sink: JournalFile | None) -> None:
+        self._sink = sink
 
     @property
     def capacity(self) -> int:
@@ -135,6 +190,10 @@ class EventJournal:
             self._events.append(event)
             if dropping:
                 self._dropped += 1
+        # persistence outside the ring lock: the file sink serializes on
+        # its own lock, so a slow disk never stalls readers of the ring
+        if self._sink is not None:
+            self._sink.write(event)
         if _on_event is not None:
             _on_event(event.kind)
         if dropping and _on_drop is not None:
@@ -181,6 +240,30 @@ def _resolve_capacity() -> int:
         return 1024
 
 
+def resolve_journal_path() -> str | None:
+    """RDP_JOURNAL_PATH resolver: where (if anywhere) to persist each
+    journal event as a JSON line. Unset/empty means in-memory only."""
+    raw = os.environ.get("RDP_JOURNAL_PATH", "").strip()
+    return raw or None
+
+
+def resolve_journal_rotate_bytes() -> int:
+    """RDP_JOURNAL_ROTATE_BYTES resolver: rotation threshold for the
+    persisted journal (default 4 MiB; floor 4 KiB applied by the sink)."""
+    raw = os.environ.get("RDP_JOURNAL_ROTATE_BYTES", "").strip()
+    try:
+        return int(raw) if raw else 4 * 1024 * 1024
+    except ValueError:
+        return 4 * 1024 * 1024
+
+
+def _resolve_sink() -> JournalFile | None:
+    path = resolve_journal_path()
+    if path is None:
+        return None
+    return JournalFile(path, resolve_journal_rotate_bytes())
+
+
 #: The process-global journal every instrumented subsystem appends to and
 #: the exposition server's /debug/events reads.
-JOURNAL = EventJournal(_resolve_capacity())
+JOURNAL = EventJournal(_resolve_capacity(), sink=_resolve_sink())

@@ -23,6 +23,18 @@ level up:
   NEW-stream placement BEFORE health ever flips: a graceful drain, not
   a failover -- its in-flight streams finish normally and the breaker
   never trips.
+- **Membership is also elastic**: the same ``rdp.fleet.ReplicaStats``
+  RPC surface carries ``Register``/``Renew``/``Leave`` unaries backed
+  by a :class:`LeaseRegistry` on the front-end. A replica announces its
+  endpoint + metrics port + version on boot (:class:`LeaseClient`,
+  wired by server.py from ``RDP_FLEET_REGISTRARS``) and renews on a
+  TTL; the router composes these leased members with the static seeds.
+  A missed lease expires the member through the EXACT health drop-out
+  path above (forced probe failure -> breaker -> quarantined, not
+  removed), so a replica respawned on a new port rejoins with zero
+  config change by simply registering again; ``Leave`` is the graceful
+  path -- the member is treated as draining (PR 13 semantics) while its
+  in-flight streams finish.
 - **Placement is least-loaded with ring tie-break**, fed by each
   replica's reported inflight/burn: a lightweight stats RPC
   (:func:`add_replica_stats_to_server`, a JSON-over-gRPC unary the
@@ -84,6 +96,45 @@ def resolve_fleet_replicas(configured: str) -> list[str]:
     return [e.strip() for e in spec.split(",") if e.strip()]
 
 
+def resolve_fleet_registrars(configured: str) -> list[str]:
+    """The front-end endpoints a replica should register its membership
+    lease with: ``RDP_FLEET_REGISTRARS`` when set, else the configured
+    value (``ServerConfig.fleet_registrars``), comma-split with blanks
+    dropped. Empty list = static membership only (no lease client)."""
+    env = os.environ.get("RDP_FLEET_REGISTRARS", "").strip()
+    spec = env if env else configured
+    return [e.strip() for e in spec.split(",") if e.strip()]
+
+
+def resolve_fleet_elastic(configured: bool) -> bool:
+    """Front-end elastic-membership switch: ``RDP_FLEET_ELASTIC`` when
+    set ("1"/"true"/"on" enable), else the configured value
+    (``ServerConfig.fleet_elastic``). Off = static membership only."""
+    env = os.environ.get("RDP_FLEET_ELASTIC", "").strip().lower()
+    if env:
+        return env in ("1", "true", "yes", "on")
+    return bool(configured)
+
+
+def resolve_fleet_peers(configured: str) -> list[str]:
+    """Sibling front-end endpoints this front-end gossips lease +
+    placement state with over the stats RPC: ``RDP_FLEET_PEERS`` when
+    set, else the configured value (``ServerConfig.fleet_peers``),
+    comma-split with blanks dropped."""
+    env = os.environ.get("RDP_FLEET_PEERS", "").strip()
+    spec = env if env else configured
+    return [e.strip() for e in spec.split(",") if e.strip()]
+
+
+def resolve_fleet_advertise(configured: str, default: str = "") -> str:
+    """The endpoint a replica advertises in its lease registration:
+    ``RDP_FLEET_ADVERTISE`` when set, else the configured value
+    (``ServerConfig.fleet_advertise``), else ``default`` (server.py
+    passes ``localhost:<bound port>``)."""
+    env = os.environ.get("RDP_FLEET_ADVERTISE", "").strip()
+    return env or configured.strip() or default
+
+
 # -- replica stats RPC -------------------------------------------------------
 #
 # A lightweight unary the replica server registers next to grpc.health.v1:
@@ -95,14 +146,27 @@ def resolve_fleet_replicas(configured: str) -> list[str]:
 
 STATS_SERVICE = "rdp.fleet.ReplicaStats"
 _STATS_PATH = f"/{STATS_SERVICE}/Get"
+_DRAIN_PATH = f"/{STATS_SERVICE}/Drain"
+_REGISTER_PATH = f"/{STATS_SERVICE}/Register"
+_RENEW_PATH = f"/{STATS_SERVICE}/Renew"
+_LEAVE_PATH = f"/{STATS_SERVICE}/Leave"
 
 
 def _identity_bytes(b):
     return bytes(b or b"")
 
 
+def _decode_json(payload: bytes) -> dict:
+    req = json.loads(payload.decode("utf-8") or "{}")
+    return req if isinstance(req, dict) else {}
+
+
 class ReplicaStatsStub:
-    """Client stub: ``stub.Get(b"")`` returns the stats JSON bytes."""
+    """Client stub: ``stub.Get(b"")`` returns the stats JSON bytes;
+    ``stub.Drain(b'{"draining": true}')`` asks a replica for a graceful
+    drain (the autoscaler's scale-down lever -- remote ``set_draining``,
+    PR 13 semantics: held out of NEW-stream placement, in-flight streams
+    finish, health stays SERVING)."""
 
     def __init__(self, channel: grpc.Channel):
         self.Get = channel.unary_unary(
@@ -110,26 +174,103 @@ class ReplicaStatsStub:
             request_serializer=_identity_bytes,
             response_deserializer=_identity_bytes,
         )
+        self.Drain = channel.unary_unary(
+            _DRAIN_PATH,
+            request_serializer=_identity_bytes,
+            response_deserializer=_identity_bytes,
+        )
 
 
-def add_replica_stats_to_server(
-        server, provider: Callable[[], dict]) -> None:
-    """Register the stats RPC; ``provider`` returns the stats dict (the
-    serving layer passes ``VisionAnalysisService.replica_stats``)."""
+class FleetLeaseStub:
+    """Client stub for the membership-lease unaries a front-end serves.
+    Requests/responses are UTF-8 JSON objects like the stats RPC."""
 
-    def get(request, context):
-        return json.dumps(provider()).encode("utf-8")
+    def __init__(self, channel: grpc.Channel):
+        kw = dict(request_serializer=_identity_bytes,
+                  response_deserializer=_identity_bytes)
+        self.Register = channel.unary_unary(_REGISTER_PATH, **kw)
+        self.Renew = channel.unary_unary(_RENEW_PATH, **kw)
+        self.Leave = channel.unary_unary(_LEAVE_PATH, **kw)
 
-    handlers = {
-        "Get": grpc.unary_unary_rpc_method_handler(
-            get,
-            request_deserializer=_identity_bytes,
-            response_serializer=_identity_bytes,
-        ),
-    }
+
+def add_fleet_rpcs_to_server(
+        server, *, stats_provider: Callable[[], dict] | None = None,
+        registry: "LeaseRegistry | None" = None,
+        drain: Callable[[bool], None] | None = None) -> None:
+    """Register whichever ``rdp.fleet.ReplicaStats`` methods this
+    process serves, as ONE generic handler: ``Get`` (stats -- replicas
+    and front-ends), ``Drain`` (remote graceful drain -- replicas), and
+    ``Register``/``Renew``/``Leave`` (membership leases -- front-ends
+    holding a :class:`LeaseRegistry`)."""
+
+    handlers: dict = {}
+    hkw = dict(request_deserializer=_identity_bytes,
+               response_serializer=_identity_bytes)
+
+    if stats_provider is not None:
+        def get(request, context):
+            return json.dumps(stats_provider()).encode("utf-8")
+
+        handlers["Get"] = grpc.unary_unary_rpc_method_handler(get, **hkw)
+
+    if drain is not None:
+        def do_drain(request, context):
+            req = _decode_json(request)
+            drain(bool(req.get("draining", True)))
+            return json.dumps({"ok": True}).encode("utf-8")
+
+        handlers["Drain"] = grpc.unary_unary_rpc_method_handler(
+            do_drain, **hkw)
+
+    if registry is not None:
+        def do_register(request, context):
+            req = _decode_json(request)
+            endpoint = str(req.get("endpoint", "")).strip()
+            if not endpoint:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "lease registration needs an endpoint")
+            resp = registry.register(
+                endpoint,
+                metrics_port=req.get("metrics_port", 0),
+                version=req.get("version", ""),
+            )
+            return json.dumps(resp).encode("utf-8")
+
+        def do_renew(request, context):
+            req = _decode_json(request)
+            resp = registry.renew(str(req.get("endpoint", "")).strip())
+            if resp is None:
+                # refused: unknown endpoint, lease already expired/left,
+                # or the renew lost the race with expiry. The client's
+                # recovery is always the same -- re-register.
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                              "no active lease; re-register")
+            return json.dumps(resp).encode("utf-8")
+
+        def do_leave(request, context):
+            req = _decode_json(request)
+            resp = registry.leave(str(req.get("endpoint", "")).strip())
+            return json.dumps(resp).encode("utf-8")
+
+        handlers["Register"] = grpc.unary_unary_rpc_method_handler(
+            do_register, **hkw)
+        handlers["Renew"] = grpc.unary_unary_rpc_method_handler(
+            do_renew, **hkw)
+        handlers["Leave"] = grpc.unary_unary_rpc_method_handler(
+            do_leave, **hkw)
+
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(STATS_SERVICE, handlers),)
     )
+
+
+def add_replica_stats_to_server(
+        server, provider: Callable[[], dict],
+        drain: Callable[[bool], None] | None = None) -> None:
+    """Register the stats RPC (and optionally the remote-drain unary);
+    ``provider`` returns the stats dict (the serving layer passes
+    ``VisionAnalysisService.replica_stats``)."""
+    add_fleet_rpcs_to_server(server, stats_provider=provider, drain=drain)
 
 
 def fetch_replica_stats(stub: ReplicaStatsStub,
@@ -140,6 +281,524 @@ def fetch_replica_stats(stub: ReplicaStatsStub,
         raise ValueError(f"replica stats payload is {type(stats).__name__},"
                          " not an object")
     return stats
+
+
+# -- membership leases -------------------------------------------------------
+#
+# The elastic half of membership: replicas announce themselves and renew
+# on a TTL; the front-end's registry runs each endpoint's lease through a
+# tiny three-state machine. Expiry is the SIGKILL/partition path (the
+# router forces the member through the health drop-out -> breaker
+# quarantine it already survives); Leave is the graceful path (treated as
+# the PR 13 draining flag). Every transition bumps its counter, journals
+# a fleet.lease event, and feeds the injectable observer the explorer
+# uses to witness edge coverage -- the breaker's set_observer idiom.
+
+LEASE_ACTIVE = "active"
+LEASE_EXPIRED = "expired"
+LEASE_LEFT = "left"
+#: the lease machine's whole vocabulary, in lifecycle order
+LEASE_STATES = (LEASE_ACTIVE, LEASE_EXPIRED, LEASE_LEFT)
+
+#: observer hook for lease transitions (endpoint, frm, to) -- injectable
+#: so analysis/explore.py witnesses edges without patching internals
+_lease_observer: Callable[[str, str, str], None] | None = None
+
+
+def set_lease_observer(
+        fn: Callable[[str, str, str], None] | None) -> None:
+    global _lease_observer
+    _lease_observer = fn
+
+
+class Lease:
+    """One endpoint's membership lease. State mutations go through
+    :meth:`_transition` (counter + journal + observer); the registry is
+    the only caller and holds its lock across them so readers never see
+    a half-applied renewal."""
+
+    def __init__(self, endpoint: str, *, ttl_s: float, now: float,
+                 metrics_port: int = 0, version: str = ""):
+        self.endpoint = endpoint
+        self.ttl_s = float(ttl_s)
+        self.metrics_port = int(metrics_port or 0)
+        self.version = str(version or "")
+        self.registered_at = now
+        self.expires_at = now + self.ttl_s
+        self.renewals = 0
+        self.state_changed_at = now
+        self._state = LEASE_ACTIVE
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def _transition(self, to: str, now: float, reason: str = "") -> None:
+        frm = self._state
+        self._state = to
+        self.state_changed_at = now
+        obs.FLEET_LEASE_TRANSITIONS.labels(state=to).inc()
+        journal_lib.JOURNAL.append(
+            events.FLEET_LEASE, endpoint=self.endpoint, frm=frm, to=to,
+            reason=reason,
+        )
+        if _lease_observer is not None:
+            _lease_observer(self.endpoint, frm, to)
+
+    def refresh(self, now: float, *, ttl_s: float, metrics_port: int = 0,
+                version: str = "") -> None:
+        """A (re-)registration landed: refresh the advertisement and
+        deadline, and re-arm a non-active lease back to active -- the
+        respawned-on-a-new-port rejoin edge. A double-register of a
+        live endpoint takes no transition (just a longer deadline)."""
+        late = now >= self.expires_at
+        self.ttl_s = float(ttl_s)
+        self.metrics_port = int(metrics_port or 0)
+        self.version = str(version or "")
+        self.registered_at = now
+        self.expires_at = now + self.ttl_s
+        if self._state != LEASE_ACTIVE:
+            self._transition(
+                LEASE_ACTIVE, now,
+                reason="re-register (late)" if late else "re-register",
+            )
+
+    def expire(self, now: float) -> bool:
+        """Take the clocked expiry edge if the deadline has passed."""
+        if self._state == LEASE_ACTIVE and now >= self.expires_at:
+            self._transition(LEASE_EXPIRED, now,
+                             reason=f"missed ttl {self.ttl_s:g}s")
+            return True
+        return False
+
+    def depart(self, now: float) -> bool:
+        """Graceful Leave: only an active lease can leave (an expired
+        member sending Leave is already gone; it must re-register)."""
+        if self._state == LEASE_ACTIVE:
+            self._transition(LEASE_LEFT, now, reason="leave")
+            return True
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Lease({self.endpoint!r}, state={self._state}, "
+                f"renewals={self.renewals})")
+
+
+class LeaseRegistry:
+    """The front-end's lease table: endpoint -> :class:`Lease`, TTL'd.
+
+    ``register``/``renew``/``leave`` back the Register/Renew/Leave
+    unaries; the router's poll loop calls :meth:`sweep` each tick so a
+    member that stops renewing expires within one poll of its deadline.
+    A renew that arrives at-or-after the deadline is REFUSED rather than
+    racing the sweep -- the sweep owns the expiry transition, and the
+    refused client re-registers (one spurious re-register beats a lease
+    that flaps between alive and expired depending on thread timing)."""
+
+    def __init__(self, *, ttl_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.ttl_s = max(0.1, float(ttl_s))
+        self._clock = clock
+        self._lock = checked_lock("fleet.leases")
+        self._leases: dict[str, Lease] = {}  # guarded_by: _lock
+
+    # -- the lease RPCs ------------------------------------------------------
+
+    def register(self, endpoint: str, *, metrics_port: int = 0,
+                 version: str = "") -> dict:
+        """Accept a (re-)registration. A double-register of a live
+        endpoint just refreshes its deadline and advertisement; an
+        expired or left endpoint transitions back to active -- the
+        respawned-on-a-new-port rejoin needs nothing else."""
+        endpoint = str(endpoint).strip()
+        if not endpoint:
+            raise ValueError("lease registration needs an endpoint")
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(endpoint)
+            if lease is None:
+                lease = Lease(endpoint, ttl_s=self.ttl_s, now=now,
+                              metrics_port=metrics_port, version=version)
+                self._leases[endpoint] = lease
+                journal_lib.JOURNAL.append(
+                    events.FLEET_LEASE, endpoint=endpoint, frm="",
+                    to=LEASE_ACTIVE, reason="register",
+                )
+            else:
+                lease.refresh(now, ttl_s=self.ttl_s,
+                              metrics_port=metrics_port, version=version)
+        obs.FLEET_LEASE_REGISTRATIONS.inc()
+        self._publish()
+        return {"ok": True, "ttl_s": self.ttl_s}
+
+    def renew(self, endpoint: str) -> dict | None:
+        """Extend an active lease; ``None`` refuses (unknown, not
+        active, or the renew lost the race with the expiry deadline on
+        the shared clock -- the client must re-register)."""
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(str(endpoint).strip())
+            if lease is None or lease.state != LEASE_ACTIVE:
+                return None
+            if now >= lease.expires_at:
+                journal_lib.JOURNAL.append(
+                    events.FLEET_LEASE, endpoint=lease.endpoint,
+                    frm=lease.state, to=lease.state,
+                    reason="renew_refused (deadline passed)",
+                )
+                return None
+            lease.expires_at = now + self.ttl_s
+            lease.renewals += 1
+        obs.FLEET_LEASE_RENEWALS.inc()
+        return {"ok": True, "ttl_s": self.ttl_s}
+
+    def leave(self, endpoint: str) -> dict:
+        """Graceful departure: the member keeps serving its in-flight
+        streams but leaves NEW-stream placement (the router treats a
+        left lease as the PR 13 draining flag)."""
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(str(endpoint).strip())
+            if lease is not None:
+                lease.depart(now)
+        self._publish()
+        return {"ok": True}
+
+    def sweep(self) -> list[str]:
+        """Expire every active lease whose deadline passed; returns the
+        endpoints expired this call. The router runs this each poll
+        tick, so expiry lands within ``poll_s`` of the deadline."""
+        now = self._clock()
+        expired: list[str] = []
+        with self._lock:
+            for lease in self._leases.values():
+                if lease.expire(now):
+                    expired.append(lease.endpoint)
+        for _ in expired:
+            obs.FLEET_LEASE_EXPIRIES.inc()
+        if expired:
+            self._publish()
+        return expired
+
+    # -- readers / maintenance ----------------------------------------------
+
+    def state_of(self, endpoint: str) -> str | None:
+        with self._lock:
+            lease = self._leases.get(endpoint)
+            return lease.state if lease is not None else None
+
+    def get(self, endpoint: str) -> Lease | None:
+        with self._lock:
+            return self._leases.get(endpoint)
+
+    def endpoints(self, state: str | None = None) -> list[str]:
+        with self._lock:
+            return [ep for ep, lease in self._leases.items()
+                    if state is None or lease.state == state]
+
+    def snapshot(self) -> dict:
+        """The gossip payload front-ends exchange over their stats RPC:
+        per-endpoint lease state with REMAINING ttl (never absolute
+        monotonic deadlines -- each process has its own clock zero)."""
+        now = self._clock()
+        with self._lock:
+            return {
+                ep: {
+                    "state": lease.state,
+                    "expires_in_s": max(0.0, lease.expires_at - now),
+                    "metrics_port": lease.metrics_port,
+                    "version": lease.version,
+                    "renewals": lease.renewals,
+                }
+                for ep, lease in self._leases.items()
+            }
+
+    def adopt(self, endpoint: str, *, expires_in_s: float,
+              metrics_port: int = 0, version: str = "") -> bool:
+        """Merge one gossiped ACTIVE lease from a sibling front-end:
+        unknown endpoints are created, known active ones keep the later
+        of the two deadlines. Never resurrects a locally expired/left
+        lease -- the member's own re-register is the only way back."""
+        endpoint = str(endpoint).strip()
+        remaining = min(max(0.0, float(expires_in_s)), self.ttl_s)
+        if not endpoint or remaining <= 0.0:
+            return False
+        now = self._clock()
+        adopted = False
+        with self._lock:
+            lease = self._leases.get(endpoint)
+            if lease is None:
+                lease = Lease(endpoint, ttl_s=self.ttl_s, now=now,
+                              metrics_port=metrics_port, version=version)
+                lease.expires_at = now + remaining
+                self._leases[endpoint] = lease
+                journal_lib.JOURNAL.append(
+                    events.FLEET_LEASE, endpoint=endpoint, frm="",
+                    to=LEASE_ACTIVE, reason="gossip_adopt",
+                )
+                adopted = True
+            elif lease.state == LEASE_ACTIVE:
+                lease.expires_at = max(lease.expires_at, now + remaining)
+        if adopted:
+            self._publish()
+        return adopted
+
+    def force_expire(self, endpoint: str) -> None:
+        """Rewind one lease's deadline to NOW (tests + the explorer:
+        the next sweep takes the honest clocked expiry edge)."""
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(endpoint)
+            if lease is not None:
+                lease.expires_at = now
+
+    def prunable(self, max_age_s: float) -> list[str]:
+        """Endpoints whose lease has sat expired/left longer than
+        ``max_age_s`` -- the router forgets these entirely (channel
+        closed, probe stopped) once their in-flight count hits zero."""
+        now = self._clock()
+        with self._lock:
+            return [
+                ep for ep, lease in self._leases.items()
+                if lease.state != LEASE_ACTIVE
+                and now - lease.state_changed_at > max_age_s
+            ]
+
+    def drop(self, endpoint: str) -> None:
+        with self._lock:
+            self._leases.pop(endpoint, None)
+        self._publish()
+
+    def _publish(self) -> None:
+        with self._lock:
+            counts = dict.fromkeys(LEASE_STATES, 0)
+            for lease in self._leases.values():
+                counts[lease.state] = counts.get(lease.state, 0) + 1
+        for state, n in counts.items():
+            obs.FLEET_LEASE_MEMBERS.labels(state=state).set(n)
+
+
+class LeaseClient:
+    """Replica-side lease loop: register with every configured registrar
+    (front-end) on boot, renew at a third of the TTL, and fall back to
+    re-registering whenever a renew is refused (the registrar restarted,
+    or we lost the race with our own deadline). ``leave`` rides the
+    graceful-drain path (server.py fires it from ``drain()``).
+
+    All RPCs are best-effort per registrar: one unreachable front-end
+    never blocks the lease with its siblings."""
+
+    def __init__(self, registrars: list[str], *, endpoint: str,
+                 metrics_port: int = 0, version: str = "",
+                 ttl_s: float = 10.0,
+                 channel_factory=grpc.insecure_channel,
+                 rpc_timeout_s: float = 2.0):
+        self.registrars = [r.strip() for r in registrars if r.strip()]
+        self.endpoint = endpoint
+        self.metrics_port = int(metrics_port or 0)
+        self.version = str(version or "")
+        self.ttl_s = max(0.1, float(ttl_s))
+        self.rpc_timeout_s = rpc_timeout_s
+        self._channel_factory = channel_factory
+        self._channels: dict[str, grpc.Channel] = {}
+        self._stubs: dict[str, FleetLeaseStub] = {}
+        self._stop: threading.Event | None = None
+        self._thread: threading.Thread | None = None
+        self.registrations = 0
+        self.renewals = 0
+
+    def _stub(self, registrar: str) -> FleetLeaseStub:
+        if registrar not in self._stubs:
+            channel = self._channel_factory(registrar)
+            self._channels[registrar] = channel
+            self._stubs[registrar] = FleetLeaseStub(channel)
+        return self._stubs[registrar]
+
+    def _payload(self) -> bytes:
+        return json.dumps({
+            "endpoint": self.endpoint,
+            "metrics_port": self.metrics_port,
+            "version": self.version,
+        }).encode("utf-8")
+
+    def register(self) -> int:
+        """Register with every registrar; returns how many accepted."""
+        ok = 0
+        for registrar in self.registrars:
+            try:
+                self._stub(registrar).Register(
+                    self._payload(), timeout=self.rpc_timeout_s)
+                ok += 1
+            except Exception as exc:  # noqa: BLE001 - per-registrar
+                log.debug("lease register with %s failed: %s",
+                          registrar, exc)
+        if ok:
+            self.registrations += 1
+        return ok
+
+    def renew_once(self) -> int:
+        """One renew round; a refused/failed renew immediately falls
+        back to Register on that registrar. Returns renews accepted."""
+        ok = 0
+        for registrar in self.registrars:
+            try:
+                self._stub(registrar).Renew(
+                    self._payload(), timeout=self.rpc_timeout_s)
+                ok += 1
+            except Exception as exc:  # noqa: BLE001 - re-register path
+                log.debug("lease renew with %s refused/failed (%s); "
+                          "re-registering", registrar, exc)
+                try:
+                    self._stub(registrar).Register(
+                        self._payload(), timeout=self.rpc_timeout_s)
+                    self.registrations += 1
+                except Exception as exc2:  # noqa: BLE001
+                    log.debug("lease re-register with %s failed: %s",
+                              registrar, exc2)
+        if ok:
+            self.renewals += 1
+        return ok
+
+    def leave(self) -> None:
+        for registrar in self.registrars:
+            try:
+                self._stub(registrar).Leave(
+                    self._payload(), timeout=self.rpc_timeout_s)
+            except Exception as exc:  # noqa: BLE001 - best-effort
+                log.debug("lease leave with %s failed: %s",
+                          registrar, exc)
+
+    def start(self) -> None:
+        if self._thread is not None or not self.registrars:
+            return
+        self.register()
+        self._stop = threading.Event()
+        interval = max(0.05, self.ttl_s / 3.0)
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.renew_once()
+                except Exception:  # pragma: no cover - keep renewing
+                    log.exception("lease renew round failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="fleet-lease", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for channel in self._channels.values():
+            channel.close()
+        self._channels.clear()
+        self._stubs.clear()
+
+
+class PeerGossip:
+    """Coordinator-free shared state between replicated front-ends.
+
+    Each front-end already SERVES a stats RPC of its own (role
+    "frontend": its lease table plus per-replica placement loads). This
+    is the consuming half: poll every sibling's stats RPC and
+
+    - **adopt** ACTIVE lease advertisements we have not heard directly
+      (a replica that registered with sibling A becomes placeable on
+      sibling B within one gossip round -- no shared store, no
+      coordinator, and :meth:`LeaseRegistry.adopt` never resurrects a
+      lease this front-end saw expire or leave);
+    - **fold** the siblings' per-replica in-flight counts into this
+      router's placement view (:meth:`FleetRouter.set_external_load`),
+      so N front-ends placing independently stop dogpiling the replica
+      each one sees as idle.
+
+    Best-effort per peer: an unreachable sibling contributes nothing
+    this round and its previously gossiped load ages out on the next
+    successful round (set_external_load replaces, never accumulates)."""
+
+    def __init__(self, peers: list[str], *, registry: LeaseRegistry,
+                 router: "FleetRouter", poll_s: float = 1.0,
+                 rpc_timeout_s: float = 2.0,
+                 channel_factory=grpc.insecure_channel):
+        self.peers = [p.strip() for p in peers if p.strip()]
+        self.registry = registry
+        self.router = router
+        self.poll_s = max(0.05, float(poll_s))
+        self.rpc_timeout_s = rpc_timeout_s
+        self._channel_factory = channel_factory
+        self._channels: dict[str, grpc.Channel] = {}
+        self._stubs: dict[str, ReplicaStatsStub] = {}
+        self._stop: threading.Event | None = None
+        self._thread: threading.Thread | None = None
+        self.rounds = 0
+        self.adopted_total = 0
+
+    def _stub(self, peer: str) -> ReplicaStatsStub:
+        if peer not in self._stubs:
+            channel = self._channel_factory(peer)
+            self._channels[peer] = channel
+            self._stubs[peer] = ReplicaStatsStub(channel)
+        return self._stubs[peer]
+
+    def poll_once(self) -> int:
+        """One gossip round; returns how many peers answered."""
+        reached = 0
+        loads: dict[str, int] = {}
+        for peer in self.peers:
+            try:
+                payload = _decode_json(
+                    self._stub(peer).Get(b"", timeout=self.rpc_timeout_s))
+            except Exception as exc:  # noqa: BLE001 - per-peer
+                log.debug("gossip with %s failed: %s", peer, exc)
+                continue
+            reached += 1
+            for ep, lease in (payload.get("leases") or {}).items():
+                if lease.get("state") != LEASE_ACTIVE:
+                    continue
+                if self.registry.adopt(
+                        ep,
+                        expires_in_s=float(lease.get("expires_in_s", 0.0)),
+                        metrics_port=int(lease.get("metrics_port", 0)),
+                        version=str(lease.get("version", ""))):
+                    self.adopted_total += 1
+            for ep, n in (payload.get("replica_loads") or {}).items():
+                try:
+                    loads[ep] = loads.get(ep, 0) + int(n)
+                except (TypeError, ValueError):
+                    continue
+        self.rounds += 1
+        self.router.set_external_load(loads)
+        return reached
+
+    def start(self) -> None:
+        if self._thread is not None or not self.peers:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(self.poll_s):
+                try:
+                    self.poll_once()
+                except Exception:  # pragma: no cover - keep gossiping
+                    log.exception("gossip round failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="fleet-gossip", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for channel in self._channels.values():
+            channel.close()
+        self._channels.clear()
+        self._stubs.clear()
 
 
 # -- placement ---------------------------------------------------------------
@@ -190,6 +849,10 @@ class Replica:
         self.draining = False
         #: front-end-placed streams currently open on this replica
         self.inflight = 0
+        #: streams SIBLING front-ends report placed here (gossip-fed;
+        #: folds into effective_load so N replicated front-ends don't
+        #: all dogpile the replica each sees as idle)
+        self.external = 0
         #: frames relayed through this replica (front-end count)
         self.frames = 0
         #: streams ever placed here
@@ -261,10 +924,11 @@ class Replica:
 
     @property
     def effective_load(self) -> float:
-        """What least-loaded pick compares: in-flight streams scaled by
-        the controller's weight (a de-weighted replica looks busier than
-        its raw count, shifting new streams away)."""
-        return self.inflight / max(self.weight, 1e-6)
+        """What least-loaded pick compares: in-flight streams (our own
+        placements plus what sibling front-ends gossip they placed
+        here) scaled by the controller's weight (a de-weighted replica
+        looks busier than its raw count, shifting new streams away)."""
+        return (self.inflight + self.external) / max(self.weight, 1e-6)
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (f"Replica({self.endpoint!r}, serving={self.serving}, "
@@ -332,14 +996,19 @@ class FleetRouter:
     own readiness. ``poll_once`` is public so tests drive membership
     deterministically without the thread."""
 
+    #: expired/left leases older than this many TTLs are forgotten
+    #: entirely (replica removed, channel closed) once idle
+    PRUNE_TTLS = 10.0
+
     def __init__(self, endpoints: list[str], *, poll_s: float = 1.0,
                  probe_timeout_s: float = 1.0, breaker_failures: int = 2,
                  breaker_reset_s: float = 5.0,
                  controller: FleetController | None = None,
                  on_membership: Callable[[int], None] | None = None,
                  clock: Callable[[], float] = time.monotonic,
-                 channel_factory=grpc.insecure_channel):
-        if not endpoints:
+                 channel_factory=grpc.insecure_channel,
+                 registry: LeaseRegistry | None = None):
+        if not endpoints and registry is None:
             raise ValueError("a fleet needs at least one replica endpoint")
         self.replicas = [
             Replica(ep, breaker_failures=breaker_failures,
@@ -347,10 +1016,18 @@ class FleetRouter:
                     channel_factory=channel_factory)
             for ep in endpoints
         ]
+        #: the static seeds: never pruned, membership is purely
+        #: health-gated for them even if one also registers a lease
+        self._static = frozenset(endpoints)
+        self.registry = registry
         self.poll_s = poll_s
         self.probe_timeout_s = probe_timeout_s
         self.controller = controller
         self.on_membership = on_membership
+        self._breaker_failures = breaker_failures
+        self._breaker_reset_s = breaker_reset_s
+        self._clock = clock
+        self._channel_factory = channel_factory
         self._lock = checked_lock("fleet.router")
         self._ring_start = 0  # guarded_by: _lock
         self._last_live = -1  # guarded_by: _lock
@@ -365,20 +1042,33 @@ class FleetRouter:
 
     def poll_once(self) -> int:
         """One membership tick; returns the live (placeable) count."""
-        for r in self.replicas:
+        if self.registry is not None:
+            self.registry.sweep()
+            self.sync_leases()
+        for r in list(self.replicas):
             healthy = False
             exc: BaseException | None = None
-            try:
-                resp = r.health_stub.Check(
-                    health_pb2.HealthCheckRequest(service=""),
-                    timeout=self.probe_timeout_s,
-                )
-                healthy = resp.status == health_lib.SERVING
-                if not healthy:
-                    exc = RuntimeError(
-                        f"health status {resp.status} (not SERVING)")
-            except Exception as e:  # noqa: BLE001 - any probe failure
-                exc = e
+            if self._lease_expired(r.endpoint):
+                # a missed lease IS a failed probe: the member stopped
+                # renewing (SIGKILL, partition, wedged renew loop), so it
+                # takes the exact NOT_SERVING drop-out path below even if
+                # a zombie socket still answers health checks. It stays
+                # in the replica list -- quarantined, not dropped -- and a
+                # re-register readmits it through the half-open probe.
+                exc = RuntimeError(
+                    f"lease expired ({r.endpoint} stopped renewing)")
+            else:
+                try:
+                    resp = r.health_stub.Check(
+                        health_pb2.HealthCheckRequest(service=""),
+                        timeout=self.probe_timeout_s,
+                    )
+                    healthy = resp.status == health_lib.SERVING
+                    if not healthy:
+                        exc = RuntimeError(
+                            f"health status {resp.status} (not SERVING)")
+                except Exception as e:  # noqa: BLE001 - any probe failure
+                    exc = e
             was = r.placeable
             if healthy:
                 r.serving = True
@@ -405,21 +1095,111 @@ class FleetRouter:
                     reason="healthy" if healthy else str(exc),
                 )
             if r.serving:
-                self._scrape_stats(r)
+                self._scrape_stats(
+                    r, lease_left=self._lease_left(r.endpoint))
             else:
                 obs.FLEET_REPLICA_BURN.labels(replica=r.endpoint).set(0.0)
         if self.controller is not None:
-            self.controller.rebalance(self.replicas)
+            self.controller.rebalance(list(self.replicas))
+        if self.registry is not None:
+            self._prune_leases()
         return self._publish_membership()
 
-    def _scrape_stats(self, r: Replica) -> None:
+    def _lease_expired(self, endpoint: str) -> bool:
+        return (self.registry is not None
+                and self.registry.state_of(endpoint) == LEASE_EXPIRED)
+
+    def _lease_left(self, endpoint: str) -> bool:
+        return (self.registry is not None
+                and self.registry.state_of(endpoint) == LEASE_LEFT)
+
+    def sync_leases(self) -> None:
+        """Fold newly ACTIVE leased endpoints into the probe set. Public
+        so tests and the explorer admit a member without waiting for (or
+        racing) the poll thread; idempotent, and the poll loop runs it
+        every tick anyway."""
+        if self.registry is None:
+            return
+        with self._lock:
+            known = {r.endpoint for r in self.replicas}
+        for ep in self.registry.endpoints(LEASE_ACTIVE):
+            if ep in known:
+                continue
+            r = Replica(ep, breaker_failures=self._breaker_failures,
+                        breaker_reset_s=self._breaker_reset_s,
+                        clock=self._clock,
+                        channel_factory=self._channel_factory)
+            lease = self.registry.get(ep)
+            if lease is not None and lease.metrics_port:
+                r.metrics_port = lease.metrics_port
+            with self._lock:
+                self.replicas.append(r)
+            log.info("fleet membership: leased replica %s joined the "
+                     "probe set", ep)
+
+    def _prune_leases(self) -> None:
+        """Forget members whose lease has sat expired/left for
+        ``PRUNE_TTLS`` TTLs: quarantine is for members expected back, a
+        week-old lease is config debt. Static seeds just shed the stale
+        lease and return to plain health gating."""
+        for ep in self.registry.prunable(
+                self.PRUNE_TTLS * self.registry.ttl_s):
+            if ep in self._static:
+                self.registry.drop(ep)
+                continue
+            removed: Replica | None = None
+            with self._lock:
+                for i, r in enumerate(self.replicas):
+                    if r.endpoint == ep and r.inflight == 0:
+                        removed = self.replicas.pop(i)
+                        break
+            if removed is not None:
+                removed.close()
+                self.registry.drop(ep)
+                log.info("fleet membership: pruned long-dead leased "
+                         "replica %s", ep)
+                journal_lib.JOURNAL.append(
+                    events.FLEET_MEMBERSHIP, replica=ep, state="pruned",
+                    reason="lease stale beyond prune horizon",
+                )
+
+    def set_external_load(self, loads: dict[str, int]) -> None:
+        """Gossip feed: streams sibling front-ends report placed on each
+        replica (an absolute snapshot, not a delta), folded into
+        ``effective_load`` so replicated front-ends don't all dogpile
+        the replica each one sees as locally idle."""
+        with self._lock:
+            for r in self.replicas:
+                r.external = max(0, int(loads.get(r.endpoint, 0)))
+
+    @property
+    def static_endpoints(self) -> frozenset:
+        """The configured seeds: health-gated only, never pruned, and
+        never the autoscaler's scale-down pick."""
+        return self._static
+
+    def placement_loads(self) -> dict[str, int]:
+        """This front-end's own placements per replica -- the load half
+        of the gossip payload siblings fold into their rings."""
+        with self._lock:
+            return {r.endpoint: r.inflight for r in self.replicas}
+
+    def _scrape_stats(self, r: Replica, lease_left: bool = False) -> None:
         """Advisory: a failed scrape never drops a healthy replica --
         placement just keeps using the front-end's own inflight count and
-        the last known burn."""
+        the last known burn. ``lease_left`` ORs into draining: a member
+        that sent Leave is treated exactly like one reporting
+        draining=true, even before its own flag flips."""
         try:
             stats = fetch_replica_stats(r.stats_stub, self.probe_timeout_s)
         except Exception as exc:  # noqa: BLE001
             log.debug("stats scrape of %s failed: %s", r.endpoint, exc)
+            if lease_left and not r.draining:
+                r.draining = True
+                journal_lib.JOURNAL.append(
+                    events.FLEET_DRAIN, replica=r.endpoint,
+                    state="draining",
+                )
             return
         r.stats = stats
         try:
@@ -431,7 +1211,7 @@ class FleetRouter:
         except (TypeError, ValueError):
             r.metrics_port = 0
         was_draining = r.draining
-        r.draining = bool(stats.get("draining", False))
+        r.draining = bool(stats.get("draining", False)) or lease_left
         if r.draining != was_draining:
             log.info(
                 "fleet membership: replica %s %s (graceful drain, health "

@@ -50,19 +50,48 @@ Observability plane (the fleet's one-stop view):
   ``replica`` label with ``rdp_replica_up``/staleness markers and fleet
   roll-ups (observability/federation.py);
 - membership changes, drains, and failover decisions land in the
-  structured event journal (``GET /debug/events?since=``).
+  structured event journal (``GET /debug/events?since=``), and on an
+  elastic front-end ``/debug/events`` serves the FLEET-wide merge: the
+  front-end's own journal plus every member's (live-scraped, last-good
+  cached), ordered by wall clock -- the same discipline as the stitched
+  ``/debug/trace``.
+
+**Elastic membership** (``ServerConfig.fleet_elastic`` /
+``RDP_FLEET_ELASTIC``): the front-end runs a
+:class:`~robotic_discovery_platform_tpu.serving.fleet.LeaseRegistry`
+and serves Register/Renew/Leave next to its vision service, so replicas
+announce themselves (serving/fleet.py ``LeaseClient``) instead of being
+listed in config -- a replica respawned on a NEW port rejoins with zero
+config edits. Replicated front-ends stay coordinator-free: each serves
+its lease table + placement loads over the stats RPC and gossips with
+its siblings (``fleet_peers`` / ``RDP_FLEET_PEERS``), adopting leases it
+has not heard directly and folding sibling load into placement. With
+``autoscaler_enabled`` the front-end also runs the capacity planner's
+control loop (serving/planner.py): scale-up spawns a self-registering
+replica, scale-down drains the least-loaded leased member through the
+Drain RPC. All of it is off by default -- the static fleet path is
+bitwise-unchanged.
 
 Like fleet.py, this module never imports jax: the front-end routes bytes.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
+import os
 import queue
 import re
+import signal
+import socket
+import subprocess
+import sys
 import threading
 import time
 from collections import deque
 from concurrent import futures
+from dataclasses import dataclass, field
+from pathlib import Path
 
 import grpc
 
@@ -77,6 +106,7 @@ from robotic_discovery_platform_tpu.observability import (
 from robotic_discovery_platform_tpu.serving import (
     fleet as fleet_lib,
     health as health_lib,
+    planner as planner_lib,
 )
 from robotic_discovery_platform_tpu.serving.proto import (
     vision_grpc,
@@ -290,9 +320,23 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
 
     def __init__(self, router: fleet_lib.FleetRouter,
                  cfg: ServerConfig = ServerConfig(),
-                 flight_recorder: recorder_lib.FlightRecorder | None = None):
+                 flight_recorder: recorder_lib.FlightRecorder | None = None,
+                 registry: fleet_lib.LeaseRegistry | None = None):
         self.router = router
         self.cfg = cfg
+        #: the elastic-membership lease table (None = static fleet);
+        #: build_frontend registers its Register/Renew/Leave RPCs next
+        #: to the vision service on this front-end's own port
+        self.registry = registry
+        #: sibling-gossip loop + autoscaler supervisor (build_frontend
+        #: wires them when configured; close() stops them)
+        self.gossip: fleet_lib.PeerGossip | None = None
+        self.supervisor: planner_lib.ElasticSupervisor | None = None
+        self.bound_port = 0  # set by build_frontend after the port bind
+        #: replica subprocesses the autoscaler spawned, by endpoint --
+        #: scale-down retires them; close() terminates any survivors
+        self.spawned: dict[str, object] = {}  # guarded_by: _spawn_lock
+        self._spawn_lock = checked_lock("frontend.spawned")
         self.health = health_lib.HealthServicer()
         self.health.set(vision_grpc.SERVICE_NAME, health_lib.NOT_SERVING)
         router.on_membership = self._on_membership
@@ -389,6 +433,80 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
             "timelines_total": sum(len(s["timelines"]) for s in sources),
             "sources": sources,
             "tree": _stitch_tree(tid, sources),
+        }
+
+    def frontend_stats(self) -> dict:
+        """This front-end's stats-RPC payload -- the gossip surface its
+        siblings poll: identity, the lease table, and the per-replica
+        placement loads they fold into their own rings."""
+        host, role = trace.identity()
+        loads = self.router.placement_loads()
+        return {
+            "role": role or "frontend",
+            "host": host,
+            "pid": os.getpid(),
+            "draining": self._closed,
+            "inflight_streams": sum(loads.values()),
+            "live_replicas": self.router.live_count,
+            "leases": (self.registry.snapshot()
+                       if self.registry is not None else {}),
+            "replica_loads": loads,
+            "metrics_port": (self.metrics_server.port
+                             if self.metrics_server is not None else 0),
+        }
+
+    def events_debug(self, since: int = 0) -> dict:
+        """The fleet-wide ``GET /debug/events`` aggregation: the
+        front-end's own journal merged with every member's (live-scraped
+        ``/debug/events``, falling back to the federator's last-good
+        cache for dead members -- a SIGKILLed replica's final entries
+        survive it), ordered by wall clock then per-process seq, the
+        same cross-host ordering the /debug/trace stitcher uses. Every
+        event carries its source host/role (stamped at append time) plus
+        a ``source`` endpoint marker added here. The ``since`` cursor
+        applies to the front-end's OWN journal (member rings are bounded
+        and merged whole; their cursors live in their own processes)."""
+        own = journal_lib.JOURNAL.snapshot(since)
+        merged = [dict(e, source="frontend") for e in own["events"]]
+        sources: list[dict] = [{
+            "source": "frontend",
+            "endpoint": None,
+            "host": own["host"],
+            "role": own["role"],
+            "fresh": True,
+            "scrape_age_s": 0.0,
+            "events": len(own["events"]),
+            "dropped_total": own["dropped_total"],
+        }]
+        for target, payload, age_s, fresh in (
+                self.federator.journal_payloads()):
+            src = {
+                "source": target.replica,
+                "endpoint": target.replica,
+                "fresh": fresh,
+                "scrape_age_s": age_s,
+            }
+            if payload is None:
+                src["events"] = 0
+                src["error"] = "unreachable and never scraped"
+            else:
+                src["host"] = payload.get("host", "")
+                src["role"] = payload.get("role", "replica")
+                member_events = payload.get("events", []) or []
+                src["events"] = len(member_events)
+                src["dropped_total"] = payload.get("dropped_total", 0)
+                merged.extend(dict(e, source=target.replica)
+                              for e in member_events)
+            sources.append(src)
+        merged.sort(key=lambda e: ((e.get("unix_ts") or 0.0),
+                                   (e.get("seq") or 0)))
+        return {
+            "role": "frontend",
+            "since": since,
+            "next_cursor": own["next_cursor"],
+            "sources": sources,
+            "events_total": len(merged),
+            "events": merged,
         }
 
     # -- the relay -----------------------------------------------------------
@@ -625,6 +743,23 @@ class FleetFrontend(vision_grpc.VisionAnalysisServiceServicer):
     def close(self) -> None:
         self._closed = True
         self.health.set_all(health_lib.NOT_SERVING)
+        # the autoscaler first (no more spawns), then its children: any
+        # member it spawned that scale-down never retired dies with the
+        # front-end that owns it
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            self.supervisor = None
+        if self.gossip is not None:
+            self.gossip.stop()
+            self.gossip = None
+        with self._spawn_lock:
+            orphans = list(self.spawned.values())
+            self.spawned.clear()
+        for handle in orphans:
+            try:
+                handle.terminate()
+            except Exception:  # pragma: no cover - teardown best-effort
+                log.exception("spawned replica teardown failed")
         if self.rollout is not None:
             try:
                 self.rollout.stop()
@@ -648,11 +783,15 @@ def build_frontend(
     the replica list is empty (a front-end with nothing behind it is a
     misconfiguration, not a degraded mode)."""
     endpoints = fleet_lib.resolve_fleet_replicas(cfg.fleet_replicas)
-    if not endpoints:
+    elastic = fleet_lib.resolve_fleet_elastic(cfg.fleet_elastic)
+    if not endpoints and not elastic:
         raise ValueError(
             "fleet front-end needs replica endpoints "
-            "(ServerConfig.fleet_replicas / RDP_FLEET_REPLICAS)"
+            "(ServerConfig.fleet_replicas / RDP_FLEET_REPLICAS) or "
+            "elastic membership (fleet_elastic / RDP_FLEET_ELASTIC)"
         )
+    registry = (fleet_lib.LeaseRegistry(ttl_s=cfg.fleet_lease_ttl_s)
+                if elastic else None)
     controller = None
     if cfg.fleet_controller_enabled:
         controller = fleet_lib.FleetController(
@@ -666,11 +805,12 @@ def build_frontend(
         breaker_failures=cfg.fleet_breaker_failures,
         breaker_reset_s=cfg.fleet_breaker_reset_s,
         controller=controller,
+        registry=registry,
     )
     # this process is the fleet's front-end: spans and journal events it
     # records are attributed to that role in merged multi-process output
     trace.set_identity(role="frontend")
-    frontend = FleetFrontend(router, cfg)
+    frontend = FleetFrontend(router, cfg, registry=registry)
     router.start()  # includes one immediate membership tick
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=cfg.max_workers)
@@ -678,21 +818,152 @@ def build_frontend(
     vision_grpc.add_VisionAnalysisServiceServicer_to_server(
         frontend, server)
     health_lib.add_HealthServicer_to_server(frontend.health, server)
-    server.add_insecure_port(cfg.address)
+    if elastic:
+        # the membership surface rides the front-end's own port: the
+        # stats RPC (identity + lease table + placement loads -- what
+        # sibling front-ends gossip over) and Register/Renew/Leave (what
+        # self-announcing replicas call)
+        fleet_lib.add_fleet_rpcs_to_server(
+            server, stats_provider=frontend.frontend_stats,
+            registry=registry)
+    frontend.bound_port = server.add_insecure_port(cfg.address)
     frontend.metrics_server = exposition.maybe_start_metrics_server(
         cfg.metrics_port
     )
     if frontend.metrics_server is not None:
         # the fleet-only surfaces ride the front-end's metrics port:
         # /debug/trace (cross-host stitch), /federate (one Prometheus
-        # target for the fleet), and the federator's warm cache
+        # target for the fleet), /debug/events (fleet-wide journal
+        # merge), and the federator's warm cache
         frontend.metrics_server.set_trace_provider(frontend.trace_debug)
         frontend.metrics_server.set_federation_provider(
             frontend.federator.render)
+        frontend.metrics_server.set_events_provider(frontend.events_debug)
         frontend.federator.start()
-    log.info("fleet front-end over %d replica(s): %s",
-             len(endpoints), ", ".join(endpoints))
+    peers = fleet_lib.resolve_fleet_peers(cfg.fleet_peers)
+    if peers and registry is not None:
+        frontend.gossip = fleet_lib.PeerGossip(
+            peers, registry=registry, router=router,
+            poll_s=max(cfg.fleet_poll_s, 0.25),
+            rpc_timeout_s=cfg.fleet_probe_timeout_s,
+        )
+        frontend.gossip.start()
+    if cfg.autoscaler_enabled and elastic:
+        frontend.supervisor = _wire_autoscaler(
+            frontend, cfg, frontend.bound_port)
+        frontend.supervisor.start()
+    log.info("fleet front-end over %d static replica(s)%s: %s",
+             len(endpoints),
+             " + elastic leases" if elastic else "",
+             ", ".join(endpoints) or "(lease-only membership)")
     return server, frontend
+
+
+def _wire_autoscaler(frontend: FleetFrontend, cfg: ServerConfig,
+                     port: int) -> planner_lib.ElasticSupervisor:
+    """Bind the planner's control loop to THIS front-end: demand from
+    the live /federate roll-ups, scale-up through the replica spawner
+    (self-registering against this front-end's own port), scale-down
+    through the Drain RPC on the least-loaded leased member."""
+    capacity = planner_lib.CapacityModel.resolve(cfg.planner_capacity_path)
+    registrar = f"localhost:{port}"
+
+    def observe() -> dict:
+        # the planner eats exactly what a human capacity-planner reads:
+        # the federated scrape's fleet roll-ups. Live count comes from
+        # the router (placeable now beats a gauge scraped a tick ago).
+        rollups = planner_lib.parse_federate_rollups(
+            frontend.federator.render())
+        rollups["live"] = frontend.router.live_count
+        return rollups
+
+    def scale_up() -> str:
+        from robotic_discovery_platform_tpu.serving import (
+            replica as replica_lib,
+        )
+
+        handle = replica_lib.spawn_local_replicas(
+            1, cfg.tracking_uri,
+            img_size=cfg.model_img_size,
+            window_ms=cfg.batch_window_ms or 2.0,
+            slo_ms=cfg.slo_ms,
+            metrics_port=-1,
+            registrars=registrar,
+            lease_ttl_s=cfg.fleet_lease_ttl_s,
+        )[0]
+        with frontend._spawn_lock:
+            frontend.spawned[handle.endpoint] = handle
+        return handle.endpoint
+
+    def pick_drain() -> str | None:
+        # leased members only (never a static seed), least loaded first
+        static = frontend.router.static_endpoints
+        candidates = [r for r in frontend.router.replicas
+                      if r.placeable and r.endpoint not in static]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: r.effective_load).endpoint
+
+    def scale_down(endpoint: str) -> None:
+        member = next((r for r in frontend.router.replicas
+                       if r.endpoint == endpoint), None)
+        if member is None:
+            return
+        # the PR 13 graceful path: set_draining on the member -- health
+        # stays SERVING, in-flight streams finish, placement stops
+        member.stats_stub.Drain(
+            json.dumps({"draining": True}).encode("utf-8"),
+            timeout=max(cfg.fleet_probe_timeout_s, 1.0))
+        member.draining = True  # act now; the next scrape re-confirms
+        with frontend._spawn_lock:
+            handle = frontend.spawned.pop(endpoint, None)
+        if handle is not None:
+            # deliberately unowned: the reaper outlives nothing (bounded
+            # deadline, then SIGTERM on the handle), and close() kills
+            # any spawned member it hadn't retired yet
+            threading.Thread(  # jaxlint: disable=JL012
+                target=_reap_drained,
+                args=(frontend.router, endpoint, handle,
+                      cfg.drain_grace_s),
+                name="fleet-reaper", daemon=True,
+            ).start()
+
+    return planner_lib.ElasticSupervisor(
+        observe=observe,
+        scale_up=scale_up,
+        scale_down=scale_down,
+        pick_drain=pick_drain,
+        capacity=capacity,
+        autoscaler=planner_lib.Autoscaler(
+            min_replicas=cfg.autoscaler_min_replicas,
+            max_replicas=cfg.autoscaler_max_replicas,
+            sustain_s=cfg.autoscaler_sustain_s,
+            cooldown_s=cfg.autoscaler_cooldown_s,
+        ),
+        headroom=cfg.planner_headroom,
+        window_ms=cfg.batch_window_ms or 2.0,
+        poll_s=max(cfg.fleet_poll_s, 0.25),
+        flight_recorder=frontend.recorder,
+    )
+
+
+def _reap_drained(router: fleet_lib.FleetRouter, endpoint: str,
+                  handle, grace_s: float) -> None:
+    """Retire one autoscaler-spawned member AFTER its drain completes:
+    wait (bounded) for its in-flight count to hit zero, then SIGTERM --
+    the replica's own shutdown sends the lease Leave."""
+    deadline = time.monotonic() + max(5.0, 2.0 * grace_s)
+    while time.monotonic() < deadline:
+        member = next((r for r in router.replicas
+                       if r.endpoint == endpoint), None)
+        if member is None or (member.inflight == 0
+                              and member.external == 0):
+            break
+        time.sleep(0.2)
+    try:
+        handle.terminate()
+    except Exception:  # pragma: no cover - teardown best-effort
+        log.exception("autoscaler retire of %s failed", endpoint)
 
 
 def serve_frontend(cfg: ServerConfig = ServerConfig()) -> None:
@@ -708,7 +979,263 @@ def serve_frontend(cfg: ServerConfig = ServerConfig()) -> None:
         frontend.close()
 
 
-if __name__ == "__main__":
-    from robotic_discovery_platform_tpu.utils.config import parse_config
+# -- local front-end cluster (tests / CI / smoke tools) ----------------------
 
-    serve_frontend(parse_config().server)
+
+#: how long spawn_local_frontends waits for each child's JSON line
+_SPAWN_TIMEOUT_S = 60.0
+
+#: the package root, prepended to each child's PYTHONPATH (same
+#: hermeticity reasoning as serving/replica.py)
+_PKG_ROOT = str(Path(__file__).resolve().parents[2])
+
+
+@dataclass
+class LocalFrontend:
+    """One spawned front-end subprocess and how to reach / kill it."""
+
+    proc: subprocess.Popen
+    endpoint: str
+    port: int
+    metrics_port: int = 0
+    argv: list[str] = field(default_factory=list)
+    env: dict = field(default_factory=dict)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        """Abrupt death (SIGKILL): the chaos leg -- a client retrying
+        against a sibling must lose zero accepted frames."""
+        if self.alive():
+            self.proc.kill()
+        self.proc.wait(timeout=30)
+
+    def terminate(self, timeout_s: float = 15.0) -> None:
+        if self.alive():
+            self.proc.terminate()
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def _free_port() -> int:
+    """Reserve-and-release an ephemeral port. Racy by nature, but the
+    front-end mesh needs every sibling's port BEFORE any of them boots
+    (each is a peer of the others), so bind-at-boot can't work."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_worker(argv: list[str], env: dict,
+                  timeout_s: float) -> tuple[subprocess.Popen, dict]:
+    proc = subprocess.Popen(
+        argv, env=env, stdout=subprocess.PIPE, stderr=sys.stderr,
+        text=True,
+    )
+    deadline = time.monotonic() + timeout_s
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.strip():
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"front-end exited rc={proc.returncode} before "
+                "reporting its port")
+    try:
+        payload = json.loads(line)
+        int(payload["port"])
+    except Exception as exc:
+        proc.kill()
+        raise RuntimeError(
+            f"front-end did not report a port (got {line!r})") from exc
+    return proc, payload
+
+
+def spawn_local_frontends(
+    n: int,
+    *,
+    replicas: str = "",
+    tracking_uri: str = "",
+    elastic: bool = True,
+    lease_ttl_s: float = 2.0,
+    poll_s: float = 0.25,
+    window_ms: float = 2.0,
+    autoscaler: bool = False,
+    autoscaler_min: int = 1,
+    autoscaler_max: int = 3,
+    sustain_s: float = 0.5,
+    cooldown_s: float = 2.0,
+    headroom: float = 0.7,
+    capacity_path: str = "",
+    metrics_port: int = -1,
+    env_overlay: dict | None = None,
+    timeout_s: float = _SPAWN_TIMEOUT_S,
+) -> list[LocalFrontend]:
+    """Boot ``n`` replicated front-end subprocesses that gossip with one
+    another (each is configured with the full sibling list as
+    ``fleet_peers``), sharing the replica set ``replicas`` plus any
+    members that lease in. Ports are pre-reserved so the peer mesh is
+    complete from the first boot. The autoscaler, when enabled, runs on
+    the FIRST front-end only -- one actuator per fleet, the same
+    one-action-at-a-time discipline the scaler itself enforces."""
+    ports = [_free_port() for _ in range(n)]
+    frontends: list[LocalFrontend] = []
+    try:
+        for i in range(n):
+            peers = ",".join(f"localhost:{p}"
+                             for j, p in enumerate(ports) if j != i)
+            env = dict(os.environ)
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in (_PKG_ROOT, env.get("PYTHONPATH")) if p
+            )
+            # "{index}" in an overlay value expands per front-end, so
+            # siblings can get e.g. distinct RDP_JOURNAL_PATH files
+            # (two processes appending one JSONL would race rotation)
+            env.update({k: str(v).replace("{index}", str(i))
+                        for k, v in (env_overlay or {}).items()})
+            argv = [
+                sys.executable, "-m",
+                "robotic_discovery_platform_tpu.serving.frontend",
+                "--worker",
+                "--port", str(ports[i]),
+                "--replicas", replicas,
+                "--peers", peers,
+                "--lease-ttl", str(lease_ttl_s),
+                "--poll-s", str(poll_s),
+                "--window-ms", str(window_ms),
+                "--metrics-port", str(metrics_port),
+            ]
+            if elastic:
+                argv += ["--elastic"]
+            if tracking_uri:
+                argv += ["--tracking-uri", tracking_uri]
+            if autoscaler and i == 0:
+                argv += [
+                    "--autoscaler",
+                    "--autoscaler-min", str(autoscaler_min),
+                    "--autoscaler-max", str(autoscaler_max),
+                    "--sustain-s", str(sustain_s),
+                    "--cooldown-s", str(cooldown_s),
+                    "--headroom", str(headroom),
+                ]
+                if capacity_path:
+                    argv += ["--capacity-path", capacity_path]
+            proc, payload = _spawn_worker(argv, env, timeout_s)
+            port = int(payload["port"])
+            frontends.append(LocalFrontend(
+                proc=proc, endpoint=f"localhost:{port}", port=port,
+                metrics_port=int(payload.get("metrics_port") or 0),
+                argv=argv, env=env,
+            ))
+            log.info("front-end %d up at localhost:%d (pid %d, "
+                     "metrics %s)", i, port, proc.pid,
+                     payload.get("metrics_port"))
+    except Exception:
+        stop_frontends(frontends)
+        raise
+    return frontends
+
+
+def stop_frontends(frontends: list[LocalFrontend]) -> None:
+    for f in frontends:
+        try:
+            f.terminate()
+        except Exception:  # pragma: no cover - teardown best-effort
+            log.exception("front-end %s teardown failed", f.endpoint)
+
+
+# -- worker entry ------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="Boot one fleet front-end and print its bound port "
+                    "as one JSON line (the spawn_local_frontends worker "
+                    "protocol)."
+    )
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--replicas", default="",
+                        help="comma-separated static replica endpoints")
+    parser.add_argument("--elastic", action="store_true",
+                        help="run a lease registry: replicas may "
+                             "Register/Renew/Leave instead of being "
+                             "listed in --replicas")
+    parser.add_argument("--peers", default="",
+                        help="comma-separated sibling front-end "
+                             "endpoints to gossip with")
+    parser.add_argument("--lease-ttl", type=float, default=10.0)
+    parser.add_argument("--poll-s", type=float, default=1.0)
+    parser.add_argument("--window-ms", type=float, default=2.0,
+                        help="batch window spawned replicas boot with")
+    parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument("--tracking-uri", default="",
+                        help="registry the autoscaler's spawned "
+                             "replicas serve from")
+    parser.add_argument("--autoscaler", action="store_true")
+    parser.add_argument("--autoscaler-min", type=int, default=1)
+    parser.add_argument("--autoscaler-max", type=int, default=4)
+    parser.add_argument("--sustain-s", type=float, default=5.0)
+    parser.add_argument("--cooldown-s", type=float, default=30.0)
+    parser.add_argument("--headroom", type=float, default=0.7)
+    parser.add_argument("--capacity-path", default="")
+    cli = parser.parse_args(argv)
+
+    cfg = ServerConfig(
+        address=f"localhost:{cli.port}",
+        tracking_uri=cli.tracking_uri,
+        metrics_port=cli.metrics_port,
+        batch_window_ms=cli.window_ms,
+        fleet_replicas=cli.replicas,
+        fleet_elastic=cli.elastic,
+        fleet_peers=cli.peers,
+        fleet_lease_ttl_s=cli.lease_ttl,
+        fleet_poll_s=cli.poll_s,
+        autoscaler_enabled=cli.autoscaler,
+        autoscaler_min_replicas=cli.autoscaler_min,
+        autoscaler_max_replicas=cli.autoscaler_max,
+        autoscaler_sustain_s=cli.sustain_s,
+        autoscaler_cooldown_s=cli.cooldown_s,
+        planner_headroom=cli.headroom,
+        planner_capacity_path=cli.capacity_path,
+    )
+    server, frontend = build_frontend(cfg)
+    server.start()
+    port = frontend.bound_port or cli.port
+    print(json.dumps({
+        "port": port,
+        "pid": os.getpid(),
+        "metrics_port": (frontend.metrics_server.port
+                         if frontend.metrics_server is not None else 0),
+    }), flush=True)
+
+    stopping = []
+
+    def on_term(signum, frame):  # graceful drain on SIGTERM
+        if not stopping:
+            stopping.append(signum)
+            server.stop(grace=cfg.drain_grace_s)
+
+    signal.signal(signal.SIGTERM, on_term)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        server.stop(grace=None)
+    finally:
+        frontend.close()
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv[1:]:
+        main([a for a in sys.argv[1:] if a != "--worker"])
+    else:
+        from robotic_discovery_platform_tpu.utils.config import (
+            parse_config,
+        )
+
+        serve_frontend(parse_config().server)

@@ -195,6 +195,8 @@ def spawn_local_replicas(
     force_cpu: int = 1,
     per_replica_env: dict[int, dict] | None = None,
     metrics_port: int = 0,
+    registrars: str = "",
+    lease_ttl_s: float = 0.0,
     timeout_s: float = _SPAWN_TIMEOUT_S,
 ) -> list[LocalReplica]:
     """Boot ``n`` replica subprocesses against one shared registry and
@@ -204,7 +206,10 @@ def spawn_local_replicas(
     how the CI fault leg arms ``RDP_FAULTS`` on exactly one fleet member
     without touching the others. ``metrics_port=-1`` gives each replica
     an ephemeral metrics endpoint (advertised back over the stats RPC),
-    which the front-end's federation + trace stitching scrape."""
+    which the front-end's federation + trace stitching scrape.
+    ``registrars`` (comma-separated front-end endpoints) makes each
+    replica self-register a membership lease on boot -- the elastic
+    path: the front-end needs no endpoint list for these members."""
     replicas: list[LocalReplica] = []
     try:
         for i in range(n):
@@ -225,6 +230,10 @@ def spawn_local_replicas(
             ]
             if metrics_port:
                 argv += ["--metrics-port", str(metrics_port)]
+            if registrars:
+                argv += ["--registrars", registrars]
+            if lease_ttl_s:
+                argv += ["--lease-ttl", str(lease_ttl_s)]
             if force_cpu:
                 argv += ["--force-cpu", str(force_cpu)]
             if warmup is not None:
@@ -314,6 +323,15 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--slo-ms", type=float, default=250.0)
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--metrics-port", type=int, default=0)
+    parser.add_argument("--registrars", default="",
+                        help="comma-separated front-end endpoints to "
+                             "register a membership lease with (elastic "
+                             "fleet; empty = static membership only)")
+    parser.add_argument("--advertise", default="",
+                        help="endpoint to advertise in the lease "
+                             "(default: localhost:<bound port>)")
+    parser.add_argument("--lease-ttl", type=float, default=0.0,
+                        help="lease TTL seconds (0 = server default)")
     parser.add_argument("--force-cpu", type=int, default=0, metavar="N",
                         help="pin this process to N virtual CPU devices "
                              "(the local-fleet harness; a real host "
@@ -344,16 +362,24 @@ def main(argv: list[str] | None = None) -> None:
     if cli.warmup:
         w, h = cli.warmup.lower().split("x")
         warmup_shape = (int(w), int(h))
+    overrides = {}
+    if cli.registrars:
+        overrides["fleet_registrars"] = cli.registrars
+    if cli.advertise:
+        overrides["fleet_advertise"] = cli.advertise
+    if cli.lease_ttl:
+        overrides["fleet_lease_ttl_s"] = cli.lease_ttl
     cfg = replica_config(
         cli.tracking_uri, port=cli.port, img_size=cli.img_size,
         window_ms=cli.window_ms, max_batch=cli.max_batch,
         slo_ms=cli.slo_ms, metrics_port=cli.metrics_port,
+        **overrides,
     )
     server, servicer = server_lib.build_server(
         cfg, warmup_shape=warmup_shape)
-    port = cli.port
-    if port == 0:
-        port = server.add_insecure_port("localhost:0")
+    # build_server already bound cfg.address (":0" included) and recorded
+    # the OS-assigned port; report that one instead of binding a second
+    port = servicer.bound_port or cli.port
     server.start()
     print(json.dumps({"port": port, "pid": os.getpid()}), flush=True)
 

@@ -60,6 +60,7 @@ serving/controller.py and the drift monitor.
 
 from __future__ import annotations
 
+import inspect
 import os
 import queue
 import threading
@@ -598,14 +599,16 @@ class RolloutManager:
 
     def _retrain(self, target) -> object:
         """Run the training function bounded by the stage timeout. The
-        thread cannot be killed mid-train; on timeout its eventual result
-        is discarded (the cycle has moved on and the candidate alias is
-        never promoted)."""
+        thread cannot be killed mid-train; on timeout the cooperative
+        cancel flag is set -- the retraining pipeline checks it at stage
+        boundaries and exits early instead of burning a full training
+        run whose candidate the cycle has already discarded."""
         result_box: list = []
+        cancel = threading.Event()
 
         def run():
             try:
-                result_box.append(self._train(target))
+                result_box.append(self._train(target, cancel))
             except Exception as exc:  # noqa: BLE001 - surfaced below
                 result_box.append(exc)
 
@@ -615,10 +618,17 @@ class RolloutManager:
         deadline = self._clock() + self.cfg.retrain_timeout_s
         while t.is_alive():
             if self._clock() >= deadline:
+                cancel.set()
+                obs.ROLLOUT_RETRAIN_CANCELS.inc()
+                journal_lib.JOURNAL.append(
+                    events.ROLLOUT_RETRAIN_CANCEL,
+                    timeout_s=self.cfg.retrain_timeout_s,
+                )
                 raise StageTimeout(
                     RETRAINING,
                     f"retraining exceeded {self.cfg.retrain_timeout_s:.0f}s"
-                    "; candidate (if any) is discarded")
+                    "; candidate (if any) is discarded and the pipeline "
+                    "is asked to stop at its next stage boundary")
             t.join(timeout=0.05)
             if t.is_alive():
                 # the injectable sleep is what advances a fake clock --
@@ -633,8 +643,18 @@ class RolloutManager:
                 f"retraining raised {type(result).__name__}: {result}")
         return result
 
-    def _train(self, target):
+    def _train(self, target, cancel: threading.Event | None = None):
         if self._train_fn is not None:
+            # legacy train_fns take only the target; pass the cancel
+            # flag to any that declare a second parameter for it
+            try:
+                params = inspect.signature(self._train_fn).parameters
+                takes_cancel = ("cancel" in params
+                                or len(params) >= 2)
+            except (TypeError, ValueError):
+                takes_cancel = False
+            if takes_cancel and cancel is not None:
+                return self._train_fn(target, cancel)
             return self._train_fn(target)
         if self._train_cfg is None:
             raise StageError(
@@ -647,7 +667,8 @@ class RolloutManager:
 
         mesh = target.training_mesh() if hasattr(target, "training_mesh") \
             else None
-        kwargs = {"mesh": mesh, "alias": self.cfg.candidate_alias}
+        kwargs = {"mesh": mesh, "alias": self.cfg.candidate_alias,
+                  "cancel": cancel}
         if self._model_cfg is not None:
             kwargs["model_cfg"] = self._model_cfg
         return run_retraining_pipeline(self._train_cfg, **kwargs)

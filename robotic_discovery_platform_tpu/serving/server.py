@@ -445,6 +445,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # Prometheus exposition endpoint; build_server starts one when
         # cfg.metrics_port / RDP_METRICS_PORT asks for it, close() stops it
         self.metrics_server: exposition.MetricsServer | None = None
+        # elastic membership (serving/fleet.py): set by build_server when
+        # registrars are configured; drain() sends Leave, close() stops it
+        self.lease_client: fleet_lib.LeaseClient | None = None
+        self.bound_port = 0  # set by build_server after add_insecure_port
         # End-to-end latency SLO (observability/slo.py): every frame's
         # total latency feeds the violation counter and the error-budget
         # burn gauge. Off unless cfg.slo_ms / RDP_SLO_MS sets an objective.
@@ -1877,6 +1881,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             self.health.set_all(health_lib.NOT_SERVING)
             journal_lib.JOURNAL.append(
                 events.SERVER_DRAIN, streams=str(self.active_streams))
+            # graceful departure beats lease expiry: tell every registrar
+            # NOW so front-ends mark this member draining (left) instead
+            # of waiting a TTL to quarantine it as failed
+            if self.lease_client is not None:
+                self.lease_client.leave()
             log.info("draining: readiness down, waiting for %d in-flight "
                      "stream(s)", self.active_streams)
         deadline = time.monotonic() + timeout_s
@@ -1899,6 +1908,9 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # flag first: an in-flight reload re-checks it before swapping, so
         # a generation built after this point never goes live
         self._closed = True
+        if self.lease_client is not None:
+            self.lease_client.stop()
+            self.lease_client = None
         if self.controller is not None:
             self.controller.stop()
         if self._reload_stop is not None:
@@ -2001,9 +2013,36 @@ def build_server(
     # Kubernetes native gRPC probes work against this port unmodified
     health_lib.add_HealthServicer_to_server(servicer.health, server)
     # replica stats next to health: the fleet front-end scrapes in-flight
-    # streams + error-budget burn here to place streams (serving/fleet.py)
-    fleet_lib.add_replica_stats_to_server(server, servicer.replica_stats)
-    server.add_insecure_port(cfg.address)
+    # streams + error-budget burn here to place streams (serving/fleet.py).
+    # Drain rides the same surface so the autoscaler can retire this
+    # member remotely through the exact PR 13 set_draining path.
+    fleet_lib.add_replica_stats_to_server(
+        server, servicer.replica_stats, drain=servicer.set_draining)
+    port = server.add_insecure_port(cfg.address)
+    # the OS-assigned port when cfg.address asked for :0 -- replica.py's
+    # worker main reports THIS port instead of binding a second one, so
+    # the advertised lease endpoint and the parent's handle always agree
+    servicer.bound_port = port
+    # elastic membership: when registrars are configured
+    # (cfg.fleet_registrars / RDP_FLEET_REGISTRARS) this replica announces
+    # itself and renews its lease; a replica respawned on a NEW port
+    # rejoins the fleet with zero config edits because the advertised
+    # endpoint defaults to the port the OS just bound
+    registrars = fleet_lib.resolve_fleet_registrars(cfg.fleet_registrars)
+    if registrars:
+        advertise = fleet_lib.resolve_fleet_advertise(
+            cfg.fleet_advertise, default=f"localhost:{port}")
+        servicer.lease_client = fleet_lib.LeaseClient(
+            registrars,
+            endpoint=advertise,
+            metrics_port=(servicer.metrics_server.port
+                          if servicer.metrics_server is not None else 0),
+            version=str(servicer.current_version),
+            ttl_s=cfg.fleet_lease_ttl_s,
+        )
+        servicer.lease_client.start()
+        log.info("fleet lease: advertising %s to %s (ttl %.1fs)",
+                 advertise, ",".join(registrars), cfg.fleet_lease_ttl_s)
     return server, servicer
 
 

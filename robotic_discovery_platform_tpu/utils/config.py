@@ -408,6 +408,51 @@ class ServerConfig:
     # idle placement weight (0 would starve its burn signal, the same
     # reason brownout rung 3 duty-cycles instead of refusing all).
     fleet_weight_floor: float = 0.1
+    # -- elastic membership (lease registration, serving/fleet.py) ----------
+    # Elastic membership master switch for the FRONT-END: when on, the
+    # front-end runs a LeaseRegistry, accepts Register/Renew/Leave RPCs
+    # from self-announcing replicas, and tolerates an empty static
+    # replica list (members arrive by lease). Off = static membership,
+    # exactly today's behavior. The RDP_FLEET_ELASTIC env var overrides.
+    fleet_elastic: bool = False
+    # Comma-separated front-end endpoints this REPLICA registers its
+    # membership lease with on boot and renews on a TTL ("" = static
+    # membership only, exactly today's behavior). The
+    # RDP_FLEET_REGISTRARS env var overrides this value.
+    fleet_registrars: str = ""
+    # Endpoint this replica advertises in its lease ("" = derive
+    # localhost:<bound port> at boot). The RDP_FLEET_ADVERTISE env var
+    # overrides this value.
+    fleet_advertise: str = ""
+    # Lease TTL: a member that misses renewals for this long is expired
+    # through the health drop-out path (renew cadence is ttl/3). Also
+    # the TTL the FRONT-END's LeaseRegistry grants.
+    fleet_lease_ttl_s: float = 10.0
+    # Comma-separated sibling front-end endpoints this FRONT-END gossips
+    # placement + lease state with over the stats RPC ("" = standalone
+    # front-end, no gossip). The RDP_FLEET_PEERS env var overrides this.
+    fleet_peers: str = ""
+    # -- autoscaler (serving/planner.py) ------------------------------------
+    # Master switch: when on, the front-end runs the capacity planner
+    # against the live /federate roll-ups and acts on its scale-up/down
+    # recommendations (spawn a self-registering replica / drain the
+    # least-loaded member). Off = static fleet, exactly today's
+    # behavior. The RDP_AUTOSCALER env var overrides this value.
+    autoscaler_enabled: bool = False
+    # Replica-count bounds the autoscaler may move between.
+    autoscaler_min_replicas: int = 1
+    autoscaler_max_replicas: int = 4
+    # PR 7 hysteresis: a scale signal must hold for sustain_s before an
+    # action fires, and after any action the scaler sleeps cooldown_s
+    # (one action at a time, never a flap).
+    autoscaler_sustain_s: float = 5.0
+    autoscaler_cooldown_s: float = 30.0
+    # Planner headroom: plan capacity so the fleet runs at no more than
+    # this fraction of its measured per-replica goodput.
+    planner_headroom: float = 0.7
+    # Optional LOADBENCH.json path the planner fits per-replica capacity
+    # from ("" = try ./LOADBENCH.json, else a conservative default).
+    planner_capacity_path: str = ""
     # -- model zoo + statistical multiplexing (serving/zoo.py) --------------
     # Comma-separated zoo roster from the models/variants.py catalog
     # ("seg,multi,aux"): the named engine generations this server holds

@@ -91,21 +91,43 @@ def capture_drift_profile(
     return str(dest)
 
 
+def _cancelled(cancel) -> bool:
+    return cancel is not None and cancel.is_set()
+
+
 def run_retraining_pipeline(
     cfg: TrainConfig = TrainConfig(),
     model_cfg: ModelConfig = ModelConfig(),
     arrays=None,
     mesh=None,
     alias: str = "staging",
+    cancel=None,
 ) -> PipelineResult:
+    """``cancel`` is a cooperative stop flag (any object with
+    ``is_set()``, usually a ``threading.Event``). It is checked at stage
+    boundaries -- before training, before promotion, before profile
+    capture -- so a caller that has given up on the cycle (the rollout
+    manager's retrain stage timeout) stops paying for work whose result
+    it will discard. A cancelled run never promotes."""
     from robotic_discovery_platform_tpu.training.trainer import train_model
 
     log.info("=== automated retraining pipeline starting ===")
     try:
+        if _cancelled(cancel):
+            return PipelineResult(False, None, None,
+                                  "cancelled before training started")
         result = train_model(cfg, model_cfg, arrays=arrays, mesh=mesh)
         if result.registry_version is None:
             return PipelineResult(False, None, None,
                                   "training completed but registered no model")
+        if _cancelled(cancel):
+            # the version exists in the registry but is never aliased:
+            # nothing serves it, and the next successful cycle's
+            # promotion supersedes it
+            return PipelineResult(
+                False, result.registry_version, None,
+                f"cancelled after training: version "
+                f"{result.registry_version} registered but NOT promoted")
         client = tracking.Client()
         latest = client.get_latest_versions(cfg.registered_model_name,
                                             stages=["None"])[0]
@@ -121,6 +143,11 @@ def run_retraining_pipeline(
         # set, and rdp_drift_profile_failures_total is how that shows up
         # on a dashboard.
         profile_path = None
+        if _cancelled(cancel):
+            msg = (f"version {latest.version} promoted to @{alias}, then "
+                   "cancelled before drift-profile capture")
+            log.info(msg)
+            return PipelineResult(True, latest.version, alias, msg)
         try:
             profile_path = capture_drift_profile(
                 int(latest.version),

@@ -1,0 +1,721 @@
+"""Elastic self-healing fleet tests (PR 17): lease-based membership
+(serving/fleet.py), the planner + autoscaler (serving/planner.py), the
+journal's JSONL persistence (observability/journal.py), and the
+front-end's fleet-wide /debug/events aggregation (serving/frontend.py).
+
+Layers, cheapest first:
+
+- lease machine units on a fake clock: lifecycle, the renew/expiry race,
+  Leave vs SIGKILL (expiry) distinction, double-register, adopt;
+- router x lease edges against a real health-only gRPC server: expiry
+  quarantines (never drops) even mid-stream, re-register rejoins through
+  the half-open probe, prune only when idle and stale;
+- the lease RPC surface + LeaseClient over real in-process gRPC;
+- PeerGossip adopt/load-fold over a real sibling stats endpoint;
+- planner units: capacity fit from a bench file, plan arithmetic +
+  burn override, Autoscaler hysteresis on a fake clock, ElasticSupervisor
+  observe->plan->decide->act over fakes with journal evidence;
+- journal persistence: JSONL sink, bounded rotation, the
+  tools/journal_tail.py merge loader;
+- front-end aggregation: frontend_stats gossip payload shape and the
+  /debug/events fleet-wide merge ordering.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from concurrent import futures
+from pathlib import Path
+
+import grpc
+import pytest
+
+from robotic_discovery_platform_tpu.observability import (
+    journal as journal_lib,
+)
+from robotic_discovery_platform_tpu.serving import (
+    fleet as fleet_lib,
+    frontend as frontend_lib,
+    health as health_lib,
+    planner as planner_lib,
+)
+from robotic_discovery_platform_tpu.utils.config import ServerConfig
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def lease_edges():
+    """Record every lease transition through the explorer's observer
+    hook; restores the previous observer afterwards."""
+    edges = []
+    restore = fleet_lib._lease_observer
+    fleet_lib.set_lease_observer(
+        lambda ep, frm, to: edges.append((ep, frm, to)))
+    yield edges
+    fleet_lib.set_lease_observer(restore)
+
+
+# -- lease machine units -----------------------------------------------------
+
+
+def test_lease_lifecycle_register_expire_reregister(lease_edges):
+    clock = _FakeClock()
+    reg = fleet_lib.LeaseRegistry(ttl_s=10.0, clock=clock)
+    reg.register("r:1")
+    assert reg.state_of("r:1") == fleet_lib.LEASE_ACTIVE
+
+    clock.t = 10.0  # deadline reached: the sweep owns the expiry edge
+    assert reg.sweep() == ["r:1"]
+    assert reg.state_of("r:1") == fleet_lib.LEASE_EXPIRED
+
+    reg.register("r:1")  # respawned member rejoins with nothing but this
+    assert reg.state_of("r:1") == fleet_lib.LEASE_ACTIVE
+    assert ("r:1", "active", "expired") in lease_edges
+    assert ("r:1", "expired", "active") in lease_edges
+
+
+def test_renew_racing_expiry_is_refused():
+    clock = _FakeClock()
+    reg = fleet_lib.LeaseRegistry(ttl_s=10.0, clock=clock)
+    reg.register("r:1")
+
+    clock.t = 5.0  # mid-lease: renew extends
+    assert reg.renew("r:1") == {"ok": True, "ttl_s": 10.0}
+    assert reg.get("r:1").expires_at == 15.0
+
+    clock.t = 15.0  # AT the deadline: the sweep owns this instant
+    assert reg.renew("r:1") is None
+    assert reg.state_of("r:1") == fleet_lib.LEASE_ACTIVE  # not yet swept
+    assert reg.sweep() == ["r:1"]
+    assert reg.renew("r:1") is None  # expired leases renew never
+    assert reg.state_of("r:1") == fleet_lib.LEASE_EXPIRED
+
+
+def test_leave_is_distinct_from_expiry(lease_edges):
+    clock = _FakeClock()
+    reg = fleet_lib.LeaseRegistry(ttl_s=10.0, clock=clock)
+    reg.register("graceful:1")
+    reg.register("killed:1")
+
+    reg.leave("graceful:1")  # Leave: the drain path
+    clock.t = 10.0
+    assert reg.sweep() == ["killed:1"]  # expiry: the SIGKILL path
+    assert reg.state_of("graceful:1") == fleet_lib.LEASE_LEFT
+    assert reg.state_of("killed:1") == fleet_lib.LEASE_EXPIRED
+    assert ("graceful:1", "active", "left") in lease_edges
+    assert ("killed:1", "active", "expired") in lease_edges
+
+    # Leave is only an edge out of ACTIVE: it cannot launder an expiry
+    reg.leave("killed:1")
+    assert reg.state_of("killed:1") == fleet_lib.LEASE_EXPIRED
+
+
+def test_double_register_refreshes_without_transition(lease_edges):
+    clock = _FakeClock()
+    reg = fleet_lib.LeaseRegistry(ttl_s=10.0, clock=clock)
+    reg.register("r:1")
+    clock.t = 4.0
+    reg.register("r:1", metrics_port=9100, version="3")
+    assert lease_edges == []  # refresh of a live lease is not an edge
+    lease = reg.get("r:1")
+    assert lease.expires_at == 14.0
+    assert lease.metrics_port == 9100
+    assert lease.version == "3"
+
+
+def test_adopt_never_resurrects_expired_or_left():
+    clock = _FakeClock()
+    reg = fleet_lib.LeaseRegistry(ttl_s=10.0, clock=clock)
+    reg.register("dead:1")
+    clock.t = 10.0
+    reg.sweep()
+    assert not reg.adopt("dead:1", expires_in_s=8.0)
+    assert reg.state_of("dead:1") == fleet_lib.LEASE_EXPIRED
+    # fresh endpoints adopt fine, clamped to the local TTL
+    assert reg.adopt("new:1", expires_in_s=99.0, metrics_port=9101)
+    assert reg.state_of("new:1") == fleet_lib.LEASE_ACTIVE
+    assert reg.get("new:1").expires_at == clock.t + 10.0
+
+
+# -- router x lease edges ----------------------------------------------------
+
+
+@pytest.fixture()
+def health_only_server():
+    health = health_lib.HealthServicer()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    health_lib.add_HealthServicer_to_server(health, server)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    yield health, f"localhost:{port}"
+    server.stop(grace=None)
+
+
+def _elastic_router(endpoint, clock, ttl_s=10.0):
+    registry = fleet_lib.LeaseRegistry(ttl_s=ttl_s, clock=clock)
+    router = fleet_lib.FleetRouter(
+        [], breaker_failures=2, breaker_reset_s=5.0, clock=clock,
+        registry=registry,
+    )
+    registry.register(endpoint)
+    return registry, router
+
+
+def test_lease_expiry_quarantines_not_drops(health_only_server):
+    health, endpoint = health_only_server
+    health.set("", health_lib.SERVING)
+    clock = _FakeClock()
+    registry, router = _elastic_router(endpoint, clock)
+    try:
+        assert router.poll_once() == 1  # leased member joins, no config
+        r = router.replicas[0]
+        assert r.endpoint == endpoint and r.placeable
+
+        # the member stops renewing: lease expiry forces the probe-failed
+        # path even though the zombie socket still answers health checks
+        clock.t = 10.0
+        assert router.poll_once() == 0
+        assert not r.placeable
+        router.poll_once()  # second forced failure opens the breaker
+        assert r.breaker.state == "open"
+        assert [x.endpoint for x in router.replicas] == [endpoint]
+
+        # re-register: health is probed again, but the open breaker holds
+        # the member out until the reset timeout admits the half-open probe
+        registry.register(endpoint)
+        assert router.poll_once() == 0
+        clock.t += 5.1
+        assert router.poll_once() == 1
+        assert r.placeable
+    finally:
+        router.stop()
+
+
+def test_lease_expiry_mid_stream_keeps_member_until_idle(
+        health_only_server):
+    health, endpoint = health_only_server
+    health.set("", health_lib.SERVING)
+    clock = _FakeClock()
+    registry, router = _elastic_router(endpoint, clock, ttl_s=1.0)
+    try:
+        router.poll_once()
+        r = router.pick()  # an in-flight relayed stream on the member
+        assert r is not None and r.inflight == 1
+
+        clock.t = 2.0
+        router.poll_once()
+        assert not r.placeable  # quarantined...
+        assert r in router.replicas  # ...but never dropped mid-stream
+
+        # even past the prune horizon the in-flight stream pins it
+        clock.t = (2.0 + fleet_lib.FleetRouter.PRUNE_TTLS
+                   * registry.ttl_s + 0.1)
+        router.poll_once()
+        assert r in router.replicas
+
+        router.release(r)  # stream finishes -> now prunable
+        router.poll_once()
+        assert r not in router.replicas
+        assert registry.state_of(endpoint) is None
+    finally:
+        router.stop()
+
+
+def test_lease_leave_drains_member(health_only_server):
+    health, endpoint = health_only_server
+    health.set("", health_lib.SERVING)
+    clock = _FakeClock()
+    registry, router = _elastic_router(endpoint, clock)
+    try:
+        assert router.poll_once() == 1
+        r = router.replicas[0]
+        registry.leave(endpoint)
+        router.poll_once()
+        assert r.serving  # health stays SERVING: graceful, not dead
+        assert r.draining and not r.placeable
+    finally:
+        router.stop()
+
+
+# -- lease RPCs + LeaseClient ------------------------------------------------
+
+
+@pytest.fixture()
+def lease_server():
+    registry = fleet_lib.LeaseRegistry(ttl_s=10.0)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    fleet_lib.add_fleet_rpcs_to_server(server, registry=registry)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    yield registry, f"localhost:{port}"
+    server.stop(grace=None)
+
+
+def test_lease_client_roundtrip(lease_server):
+    registry, registrar = lease_server
+    client = fleet_lib.LeaseClient(
+        [registrar], endpoint="replica-x:50051", metrics_port=9100,
+        version="5", ttl_s=10.0)
+    try:
+        assert client.register() == 1
+        lease = registry.get("replica-x:50051")
+        assert lease is not None and lease.metrics_port == 9100
+        assert lease.version == "5"
+        assert client.renew_once() == 1
+        assert registry.get("replica-x:50051").renewals == 1
+        client.leave()
+        assert registry.state_of("replica-x:50051") == fleet_lib.LEASE_LEFT
+    finally:
+        client.stop()
+
+
+def test_lease_client_refused_renew_falls_back_to_register(lease_server):
+    registry, registrar = lease_server
+    client = fleet_lib.LeaseClient(
+        [registrar], endpoint="replica-y:50052", ttl_s=10.0)
+    try:
+        # never registered: the renew is refused (FAILED_PRECONDITION)
+        # and the client immediately re-registers on the same registrar
+        assert client.renew_once() == 0
+        assert client.registrations == 1
+        assert registry.state_of("replica-y:50052") == fleet_lib.LEASE_ACTIVE
+    finally:
+        client.stop()
+
+
+# -- gossip ------------------------------------------------------------------
+
+
+def test_gossip_adopts_leases_and_folds_loads():
+    sibling_payload = {
+        "role": "frontend",
+        "leases": {
+            "replica-g:1": {"state": "active", "expires_in_s": 7.0,
+                            "metrics_port": 9100, "version": "2"},
+            "replica-dead:1": {"state": "expired", "expires_in_s": 0.0},
+        },
+        "replica_loads": {"static:1": 3},
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    fleet_lib.add_fleet_rpcs_to_server(
+        server, stats_provider=lambda: sibling_payload)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+
+    clock = _FakeClock()
+    registry = fleet_lib.LeaseRegistry(ttl_s=10.0, clock=clock)
+    router = fleet_lib.FleetRouter(
+        ["static:1"], clock=clock, registry=registry,
+        channel_factory=lambda ep: None)
+    gossip = fleet_lib.PeerGossip(
+        [f"localhost:{port}"], registry=registry, router=router)
+    try:
+        assert gossip.poll_once() == 1
+        # the sibling's leased member is adoptable within one round...
+        assert registry.state_of("replica-g:1") == fleet_lib.LEASE_ACTIVE
+        assert gossip.adopted_total == 1
+        # ...its expired one is not, and the sibling's placements fold
+        # into this router's effective-load view
+        assert registry.state_of("replica-dead:1") is None
+        assert router.replicas[0].external == 3
+        assert router.replicas[0].effective_load == 3.0
+    finally:
+        gossip.stop()
+        router.stop()
+        server.stop(grace=None)
+
+
+# -- planner -----------------------------------------------------------------
+
+
+def _write_loadbench(path, rows):
+    path.write_text(json.dumps({"slo_ms": 50.0, "rows": rows}))
+    return str(path)
+
+
+def test_capacity_fit_picks_best_within_budget(tmp_path):
+    bench = _write_loadbench(tmp_path / "LOADBENCH.json", [
+        {"goodput_rps": 40.0, "violation_rate": 0.01, "chips": 2,
+         "placement": "shared", "p99_ms": 30.0},
+        {"goodput_rps": 90.0, "violation_rate": 0.30, "chips": 4,
+         "placement": "dedicated"},  # fast but outside the budget
+        {"goodput_rps": 60.0, "violation_rate": 0.04, "chips": 4,
+         "placement": "dedicated", "p99_ms": 45.0},
+    ])
+    cap = planner_lib.CapacityModel.from_loadbench(bench)
+    assert cap.goodput_rps == 60.0
+    assert cap.chips == 4 and cap.placement == "dedicated"
+    assert cap.slo_ms == 50.0
+
+    with pytest.raises(ValueError):
+        planner_lib.CapacityModel.from_loadbench(_write_loadbench(
+            tmp_path / "bad.json",
+            [{"goodput_rps": 10.0, "violation_rate": 0.9}]))
+
+
+def test_capacity_resolve_reads_benches_and_falls_back(tmp_path):
+    # no benches at all: the conservative default
+    cap = planner_lib.CapacityModel.resolve(root=tmp_path)
+    assert cap.goodput_rps == planner_lib.DEFAULT_GOODPUT_RPS
+    assert cap.precision == "f32"
+
+    (tmp_path / "PALLASBENCH.json").write_text(
+        json.dumps({"dtype": "bfloat16 in / f32 accumulate"}))
+    _write_loadbench(tmp_path / "LOADBENCH.json",
+                     [{"goodput_rps": 25.0, "violation_rate": 0.0}])
+    cap = planner_lib.CapacityModel.resolve(root=tmp_path)
+    assert cap.goodput_rps == 25.0
+    assert cap.precision == "bf16"  # the Pallas bench sets the tier
+
+    # the repo's own benches resolve without raising
+    cap = planner_lib.CapacityModel.resolve(root=REPO_ROOT)
+    assert cap.goodput_rps > 0
+
+
+def test_parse_federate_rollups():
+    text = "\n".join([
+        "# HELP rdp_fleet_model_arrival_rate per-model demand",
+        'rdp_fleet_model_arrival_rate{model="a",replica="r1:1"} 12.5',
+        'rdp_fleet_model_arrival_rate{model="a",replica="r2:1"} 7.5',
+        'rdp_fleet_model_arrival_rate{model="b",replica="r1:1"} 5.0',
+        'rdp_fleet_burn{stat="max"} 1.25',
+        'rdp_fleet_burn{stat="mean"} 0.4',
+        "rdp_fleet_replicas_live 2",
+        "not a sample",
+    ])
+    rollups = planner_lib.parse_federate_rollups(text)
+    assert rollups["demand_rps"] == 25.0
+    assert rollups["rates"] == {"a": 20.0, "b": 5.0}
+    assert rollups["burn_max"] == 1.25
+    assert rollups["live"] == 2
+
+
+def test_plan_arithmetic_and_burn_override():
+    cap = planner_lib.CapacityModel(goodput_rps=50.0, chips=2,
+                                    precision="bf16")
+    # 120 rps / (50 * 0.8) = 3 replicas
+    p = planner_lib.plan(120.0, 2, capacity=cap, headroom=0.8,
+                         max_replicas=4)
+    assert (p.target_replicas, p.recommendation) == (3, "scale_up")
+    assert p.chips == 2 and p.precision == "bf16"
+
+    # demand fits, but a burning fleet still grows by one
+    p = planner_lib.plan(30.0, 2, capacity=cap, burn_max=1.5,
+                         max_replicas=4)
+    assert (p.target_replicas, p.recommendation) == (3, "scale_up")
+    assert "burn" in p.reason
+
+    # clamped at max even when demand wants more
+    p = planner_lib.plan(500.0, 4, capacity=cap, max_replicas=4)
+    assert (p.target_replicas, p.recommendation) == (4, "hold")
+
+    # idle fleet shrinks to min, never below
+    p = planner_lib.plan(0.0, 3, capacity=cap, min_replicas=1)
+    assert (p.target_replicas, p.recommendation) == (1, "scale_down")
+
+
+def test_autoscaler_hysteresis_on_fake_clock():
+    clock = _FakeClock()
+    scaler = planner_lib.Autoscaler(
+        min_replicas=1, max_replicas=4, sustain_s=5.0, cooldown_s=30.0,
+        clock=clock)
+    cap = planner_lib.CapacityModel(goodput_rps=50.0)
+
+    def verdict(demand, live):
+        return planner_lib.plan(demand, live, capacity=cap, headroom=1.0,
+                                max_replicas=4)
+
+    clock.t = 100.0
+    assert scaler.decide(verdict(120.0, 2)) == "hold_sustain"  # new signal
+    clock.t = 102.0
+    assert scaler.decide(verdict(120.0, 2)) == "hold_sustain"  # sustaining
+    clock.t = 103.0
+    assert scaler.decide(verdict(80.0, 2)) == "hold"  # blip: pending clears
+    clock.t = 104.0
+    assert scaler.decide(verdict(120.0, 2)) == "hold_sustain"  # restarts
+    clock.t = 109.1
+    assert scaler.decide(verdict(120.0, 2)) == "scale_up"  # sustained
+    assert scaler.actions_total == 1
+    clock.t = 115.0
+    assert scaler.decide(verdict(200.0, 3)) == "hold_cooldown"  # quiet
+    clock.t = 139.2
+    assert scaler.decide(verdict(200.0, 3)) == "scale_up"  # pending clock
+    # kept running through the cooldown, so the action fires on its end
+    assert scaler.actions_total == 2
+
+    # the planner may want more than this scaler's bounds allow
+    # (its cluster may be bigger on paper): the scaler holds the line
+    clock.t = 200.0
+    wants_more = planner_lib.plan(500.0, 4, capacity=cap,
+                                  max_replicas=8)
+    assert wants_more.recommendation == "scale_up"
+    assert scaler.decide(wants_more) == "hold_bounds"  # at max (4)
+    wants_less = planner_lib.plan(0.0, 1, capacity=cap, min_replicas=0)
+    assert wants_less.recommendation == "scale_down"
+    assert scaler.decide(wants_less) == "hold_bounds"  # at min (1)
+    assert scaler.actions_total == 2  # bounds never act
+
+
+def test_autoscaler_rejects_bad_bounds():
+    with pytest.raises(ValueError):
+        planner_lib.Autoscaler(min_replicas=0)
+    with pytest.raises(ValueError):
+        planner_lib.Autoscaler(min_replicas=3, max_replicas=2)
+
+
+def test_supervisor_round_trip_with_journal_evidence():
+    clock = _FakeClock()
+    cap = planner_lib.CapacityModel(goodput_rps=50.0)
+    demand = {"demand_rps": 120.0, "burn_max": 0.0, "live": 2}
+    spawned, drained = [], []
+    sup = planner_lib.ElasticSupervisor(
+        observe=lambda: dict(demand),
+        scale_up=lambda: (spawned.append("new:1"), "new:1")[1],
+        scale_down=drained.append,
+        pick_drain=lambda: "old:1",
+        capacity=cap,
+        autoscaler=planner_lib.Autoscaler(
+            max_replicas=4, sustain_s=1.0, cooldown_s=2.0, clock=clock),
+        headroom=1.0,
+    )
+    cursor = journal_lib.JOURNAL.snapshot()["next_cursor"]
+    clock.t = 10.0
+    assert sup.tick()["action"] == "hold_sustain"
+    clock.t = 11.1
+    out = sup.tick()
+    assert out["action"] == "scale_up" and out["detail"] == "new:1"
+    assert spawned == ["new:1"]
+
+    # the scale-down path drains what pick_drain chose
+    demand.update(demand_rps=0.0, live=3)
+    clock.t = 20.0
+    sup.tick()
+    clock.t = 21.2
+    out = sup.tick()
+    assert out["action"] == "scale_down" and out["detail"] == "old:1"
+    assert drained == ["old:1"]
+
+    # every acted tick left journal evidence (the acceptance surface:
+    # the same events /debug/events aggregates fleet-wide)
+    kinds = [e["kind"] for e in
+             journal_lib.JOURNAL.snapshot(cursor)["events"]]
+    assert kinds.count("autoscaler.action") == 2
+    assert "planner.plan" in kinds
+    assert sup.snapshot()["actions_total"] == 2
+
+
+def test_supervisor_scale_down_degrades_without_drain_pick():
+    clock = _FakeClock()
+    sup = planner_lib.ElasticSupervisor(
+        observe=lambda: {"demand_rps": 0.0, "burn_max": 0.0, "live": 3},
+        scale_up=lambda: "",
+        scale_down=lambda ep: None,
+        pick_drain=lambda: None,  # statics only: nothing drainable
+        capacity=planner_lib.CapacityModel(goodput_rps=50.0),
+        autoscaler=planner_lib.Autoscaler(
+            sustain_s=0.0, cooldown_s=0.0, clock=clock),
+    )
+    clock.t = 1.0
+    sup.tick()
+    clock.t = 2.0
+    out = sup.tick()
+    assert out["action"] == "hold"
+    assert out["detail"] == "no drainable member"
+
+
+# -- journal persistence -----------------------------------------------------
+
+
+def test_journal_file_persists_and_rotates(tmp_path):
+    path = tmp_path / "journal.jsonl"
+    sink = journal_lib.JournalFile(str(path), rotate_bytes=4096)
+    journal = journal_lib.EventJournal(capacity=8, sink=sink)
+    for i in range(40):  # enough to cross 4096 bytes and rotate
+        journal.append("test.persist", index=str(i),
+                       padding="x" * 120)
+
+    assert path.exists() and Path(str(path) + ".1").exists()
+    # rotation is bounded: live + one generation, nothing else
+    assert not Path(str(path) + ".2").exists()
+    live = [json.loads(line) for line in
+            path.read_text().splitlines() if line.strip()]
+    gen1 = [json.loads(line) for line in
+            Path(str(path) + ".1").read_text().splitlines()
+            if line.strip()]
+    assert all(e["kind"] == "test.persist" for e in live + gen1)
+
+    # the persisted window is a contiguous, ordered SUFFIX of the run
+    # (older generations are shed, never reordered or torn) and it is
+    # strictly deeper than the in-memory ring
+    persisted = [int(e["attrs"]["index"]) for e in gen1 + live]
+    assert persisted == list(range(persisted[0], 40))
+    ring = journal.snapshot()
+    assert len(ring["events"]) == 8
+    assert len(persisted) > len(ring["events"])
+
+
+def test_journal_resolvers(monkeypatch, tmp_path):
+    monkeypatch.delenv("RDP_JOURNAL_PATH", raising=False)
+    monkeypatch.delenv("RDP_JOURNAL_ROTATE_BYTES", raising=False)
+    assert journal_lib.resolve_journal_path() is None
+    monkeypatch.setenv("RDP_JOURNAL_PATH", str(tmp_path / "j.jsonl"))
+    assert journal_lib.resolve_journal_path() == str(tmp_path / "j.jsonl")
+    monkeypatch.setenv("RDP_JOURNAL_ROTATE_BYTES", "8192")
+    assert journal_lib.resolve_journal_rotate_bytes() == 8192
+    monkeypatch.setenv("RDP_JOURNAL_ROTATE_BYTES", "nonsense")
+    assert journal_lib.resolve_journal_rotate_bytes() > 0  # default
+
+
+def test_journal_tail_merges_sources(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    sink_a = journal_lib.JournalFile(str(a))
+    sink_b = journal_lib.JournalFile(str(b))
+    ja = journal_lib.EventJournal(capacity=8, sink=sink_a)
+    jb = journal_lib.EventJournal(capacity=8, sink=sink_b)
+    ja.append("fleet.lease", endpoint="r:1")
+    jb.append("autoscaler.action", action="scale_up")
+    ja.append("fleet.membership", replica="r:1")
+    b.write_text(b.read_text() + "{torn line\n")  # SIGKILL mid-write
+
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "journal_tail.py"),
+         "--json", str(a), str(b)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    merged = json.loads(out.stdout)
+    assert [e["kind"] for e in merged] == [
+        "fleet.lease", "autoscaler.action", "fleet.membership"]
+    assert merged[0]["source"] == str(a)
+    assert merged[1]["source"] == str(b)
+
+    # filters work and an all-missing load fails loudly
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "journal_tail.py"),
+         "--kind", "autoscaler", "--json", str(a), str(b)],
+        capture_output=True, text=True, timeout=60)
+    assert [e["kind"] for e in json.loads(out.stdout)] == [
+        "autoscaler.action"]
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "journal_tail.py"),
+         str(tmp_path / "missing.jsonl")],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2
+
+
+# -- front-end aggregation ---------------------------------------------------
+
+
+class _FakeTarget:
+    def __init__(self, replica):
+        self.replica = replica
+
+
+class _FakeFederator:
+    """Duck-typed stand-in for FleetFederator: canned journal payloads
+    (one live member, one SIGKILLed member served from the last-good
+    cache, one never reached)."""
+
+    def __init__(self, payloads):
+        self.payloads = payloads
+
+    def journal_payloads(self):
+        return self.payloads
+
+    def stop(self):
+        pass
+
+
+def _frontend_over_fakes():
+    router = fleet_lib.FleetRouter(
+        ["a:1"], channel_factory=lambda ep: None,
+        registry=fleet_lib.LeaseRegistry(ttl_s=10.0))
+    cfg = ServerConfig(fleet_replicas="a:1")
+    fe = frontend_lib.FleetFrontend(router, cfg, registry=router.registry)
+    return fe
+
+
+def test_frontend_stats_is_the_gossip_surface():
+    fe = _frontend_over_fakes()
+    try:
+        fe.registry.register("leased:1", metrics_port=9100)
+        fe.router.sync_leases()
+        stats = fe.frontend_stats()
+        assert stats["role"]  # identity role (RDP_ROLE or fallback)
+        assert stats["pid"] > 0
+        assert stats["draining"] is False
+        assert stats["leases"]["leased:1"]["state"] == "active"
+        assert set(stats["replica_loads"]) == {"a:1", "leased:1"}
+        assert stats["inflight_streams"] == 0
+    finally:
+        fe.close()
+
+
+def test_events_debug_merges_fleet_wide():
+    fe = _frontend_over_fakes()
+    try:
+        cursor = journal_lib.JOURNAL.snapshot()["next_cursor"]
+        journal_lib.JOURNAL.append("frontend.local", marker="own")
+        now = time.time()
+        fe.federator = _FakeFederator([
+            (_FakeTarget("r1:1"), {
+                "host": "h1", "role": "replica", "dropped_total": 0,
+                "events": [
+                    {"seq": 5, "unix_ts": now - 10.0,
+                     "kind": "fleet.membership", "host": "h1",
+                     "role": "replica", "attrs": {}},
+                    {"seq": 6, "unix_ts": now + 10.0,
+                     "kind": "serving.rollout.transition", "host": "h1",
+                     "role": "replica", "attrs": {}},
+                ]}, 0.0, True),
+            (_FakeTarget("r2:1"), {
+                "host": "h2", "role": "replica", "dropped_total": 2,
+                "events": [
+                    {"seq": 9, "unix_ts": now - 10.0,
+                     "kind": "breaker.transition", "host": "h2",
+                     "role": "replica", "attrs": {}},
+                ]}, 31.0, False),  # SIGKILLed: last-good cache, stale
+            (_FakeTarget("r3:1"), None, 0.0, False),  # never reached
+        ])
+        out = fe.events_debug(since=cursor)
+
+        assert out["events_total"] == 4
+        # wall clock first, per-process seq breaking ties: the two
+        # members' t-10 events land before the front-end's own append,
+        # and the future-stamped member event lands last
+        kinds = [e["kind"] for e in out["events"]]
+        assert kinds == ["fleet.membership", "breaker.transition",
+                         "frontend.local", "serving.rollout.transition"]
+        sources = {s["source"]: s for s in out["sources"]}
+        assert sources["frontend"]["fresh"] is True
+        assert sources["r2:1"]["fresh"] is False
+        assert sources["r2:1"]["dropped_total"] == 2
+        assert sources["r3:1"]["error"] == "unreachable and never scraped"
+        # every merged event is marked with where it came from
+        assert {e["source"] for e in out["events"]} == {
+            "frontend", "r1:1", "r2:1"}
+    finally:
+        fe.close()
+
+
+def test_elastic_frontend_allows_empty_seed_list():
+    # the static-config guard stays (tested in test_fleet.py); elastic
+    # membership is the documented way to boot with zero seeds
+    cfg = ServerConfig(fleet_replicas="", fleet_elastic=True)
+    server, fe = frontend_lib.build_frontend(cfg)
+    try:
+        assert fe.registry is not None
+        assert fe.bound_port > 0
+        assert fe.router.live_count == 0
+    finally:
+        server.stop(grace=None)
+        fe.close()

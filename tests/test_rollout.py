@@ -319,6 +319,60 @@ def test_retrain_timeout_discards_candidate():
     assert b.draining is False and mgr.state == rollout_lib.IDLE
 
 
+def test_retrain_timeout_preempts_cooperatively():
+    """The stage timeout does not just abandon the train thread: it sets
+    the cooperative cancel flag (and counts the preemption), so a
+    cancel-aware trainer stops paying for work whose result the cycle
+    already discarded."""
+    a, b = FakeTarget("a", streams=1), FakeTarget("b")
+    seen = {}
+    release = threading.Event()
+
+    def hung_train(target, cancel):
+        seen["cancel"] = cancel
+        release.wait(timeout=30)
+        return FakeResult(True, 9)
+
+    before = obs.ROLLOUT_RETRAIN_CANCELS.value
+    mgr, clock = _stub([a, b], train_fn=hung_train, retrain_timeout_s=0.5)
+    try:
+        cycle = mgr.run_cycle(_rec())
+    finally:
+        release.set()
+    assert cycle["outcome"] == "rolled_back"
+    assert cycle["rolled_back_at"] == rollout_lib.RETRAINING
+    assert "stop at its next stage boundary" in cycle["error"]
+    assert seen["cancel"] is not None and seen["cancel"].is_set()
+    assert obs.ROLLOUT_RETRAIN_CANCELS.value == before + 1
+    # a trainer that finishes WITHIN the deadline never sees a set flag
+    quick = {}
+
+    def quick_train(target, cancel):
+        quick["cancel"] = cancel
+        return FakeResult(True, 7)
+
+    live, spare = FakeTarget("a", streams=2), FakeTarget("b")
+    live.feed_on_shadow = 4  # the live replica mirrors into the tap
+    mgr2, _ = _stub([live, spare], train_fn=quick_train)
+    cycle2 = mgr2.run_cycle(_rec())
+    assert cycle2["outcome"] == "promoted"
+    assert not quick["cancel"].is_set()
+
+
+def test_retraining_pipeline_honors_preset_cancel():
+    """Pipeline-level checkpoint: a cancel flag that is already set
+    stops the run before any training happens, and the result says so
+    (never a silent success, never a promotion)."""
+    cancel = threading.Event()
+    cancel.set()
+    from robotic_discovery_platform_tpu.workflows import retraining
+
+    res = retraining.run_retraining_pipeline(cancel=cancel)
+    assert res.succeeded is False
+    assert res.version is None and res.promoted_alias is None
+    assert "cancelled before training" in res.message
+
+
 def test_shadow_timeout_without_frames_fails_closed():
     a, b = FakeTarget("a", streams=1), FakeTarget("b")
     a.feed_on_shadow = 0  # no live traffic ever mirrored

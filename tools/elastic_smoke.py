@@ -1,0 +1,395 @@
+#!/usr/bin/env python
+"""CI elastic-fleet smoke: the self-healing loop end to end.
+
+Boots ONE static seed replica and TWO replicated front-ends (peered
+gossip mesh, lease registry on each, autoscaler on the first), then
+drives the three elastic legs in order:
+
+1. **Scale-up**: Poisson frame arrivals push measured demand past the
+   capacity fit (a synthetic LOADBENCH capacity file keeps the trigger
+   deterministic); the autoscaler must spawn a SECOND replica that
+   self-registers over the lease RPCs -- no config change anywhere --
+   and both front-ends must converge on 2 placeable members (one via
+   gossip adoption, not direct registration).
+2. **Front-end chaos**: SIGKILL the second front-end mid-stream; the
+   client retries against the surviving sibling and finishes with ZERO
+   lost accepted frames. The killed front-end's journal survives as its
+   ``RDP_JOURNAL_PATH`` JSONL file, readable post-mortem with
+   tools/journal_tail.py.
+3. **Scale-down**: cut the load; once the demand window drains the
+   autoscaler must retire the member it spawned through the graceful
+   drain path (never the static seed), and the round trip must be
+   visible in ``GET /debug/events`` (planner.plan + autoscaler.action
+   scale_up/scale_down + fleet.lease) and in
+   ``rdp_autoscaler_actions_total`` on the front-end's /metrics.
+
+Run under both strict sanitizers:
+``env JAX_PLATFORMS=cpu RDP_LOCKCHECK=strict RDP_TRANSFER_GUARD=strict
+python tools/elastic_smoke.py``. Exit 0 on success.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import random
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from robotic_discovery_platform_tpu.observability import (  # noqa: E402
+    events as event_kinds,
+    families,
+)
+
+#: synthetic capacity fit: ~2 rps per replica keeps the Poisson trigger
+#: deterministic on any CI box (the real LOADBENCH measures hundreds)
+CAPACITY_GOODPUT_RPS = 2.0
+LOAD_RATE_HZ = 8.0
+
+
+def _get(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.read().decode()
+
+
+def _fail(msg: str, extra=None) -> int:
+    print(f"FAIL: {msg}")
+    if extra is not None:
+        print(json.dumps(extra, indent=1, default=str)[:4000])
+    return 1
+
+
+def _frontend_stats(fleet_lib, grpc, endpoint: str) -> dict:
+    """One stats-RPC Get against a front-end: its live count, lease
+    table, and placement loads (the same payload siblings gossip)."""
+    with grpc.insecure_channel(endpoint) as channel:
+        stub = fleet_lib.ReplicaStatsStub(channel)
+        return json.loads(stub.Get(b"", timeout=10).decode("utf-8"))
+
+
+def _wait(predicate, timeout_s: float, poll_s: float = 0.3):
+    """Poll until ``predicate()`` returns a truthy value; returns it
+    (or the last falsy value after the deadline)."""
+    deadline = time.monotonic() + timeout_s
+    value = None
+    while time.monotonic() < deadline:
+        try:
+            value = predicate()
+        except Exception:  # noqa: BLE001 - a booting member refuses RPCs
+            value = None
+        if value:
+            return value
+        time.sleep(poll_s)
+    return value
+
+
+def main() -> int:
+    import os
+
+    os.environ.pop("RDP_METRICS_PORT", None)
+
+    from robotic_discovery_platform_tpu.utils.platforms import (
+        force_cpu_platform,
+    )
+
+    force_cpu_platform(min_devices=1)
+
+    import grpc
+
+    from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+    from robotic_discovery_platform_tpu.serving import (
+        client as client_lib,
+        fleet as fleet_lib,
+        frontend as frontend_lib,
+        replica as replica_lib,
+    )
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+
+    tmp = Path(tempfile.mkdtemp(prefix="rdp-elastic-"))
+    uri = replica_lib.register_tiny_model(tmp / "mlruns", img_size=64)
+    capacity_path = tmp / "CAPACITY.json"
+    capacity_path.write_text(json.dumps({
+        "slo_ms": 250.0,
+        "rows": [{"goodput_rps": CAPACITY_GOODPUT_RPS,
+                  "violation_rate": 0.0, "chips": 1,
+                  "placement": "shared"}],
+    }))
+
+    replicas = replica_lib.spawn_local_replicas(
+        1, uri, img_size=64, slo_ms=250.0, metrics_port=-1)
+    seed_ep = replicas[0].endpoint
+    frontends: list = []
+    stop_load = threading.Event()
+    load_thread = None
+    rc = 1
+    try:
+        replica_lib.wait_serving([seed_ep])
+        frontends = frontend_lib.spawn_local_frontends(
+            2,
+            replicas=seed_ep,
+            tracking_uri=uri,
+            elastic=True,
+            lease_ttl_s=2.0,
+            poll_s=0.2,
+            autoscaler=True,
+            autoscaler_min=1,
+            autoscaler_max=2,
+            sustain_s=1.0,
+            cooldown_s=5.0,
+            headroom=0.7,
+            capacity_path=str(capacity_path),
+            metrics_port=-1,
+            env_overlay={
+                "RDP_JOURNAL_PATH": str(tmp / "fe-{index}.jsonl"),
+            },
+        )
+        fe1, fe2 = frontends
+        if not fe1.metrics_port:
+            return _fail("autoscaler front-end has no metrics port")
+
+        # both front-ends must see the static seed before load starts
+        for fe in frontends:
+            stats = _wait(
+                lambda fe=fe: (_frontend_stats(fleet_lib, grpc,
+                                               fe.endpoint)
+                               .get("live_replicas", 0) >= 1 or None),
+                timeout_s=60)
+            if not stats:
+                return _fail(f"front-end {fe.endpoint} never saw the "
+                             "seed replica")
+
+        src = SyntheticSource(width=64, height=48, seed=5, n_frames=1)
+        src.start()
+        color, depth = src.get_frames()
+        src.stop()
+        request = client_lib.encode_request(color, depth)
+
+        # -- leg 1: Poisson load -> autoscaler spawns a leased member --
+        counts = {"sent": 0, "acked": 0}
+
+        def poisson_load():
+            rng = random.Random(11)
+            while not stop_load.is_set():
+                outbox: queue.Queue = queue.Queue()
+
+                def gen(q=outbox):
+                    while True:
+                        item = q.get()
+                        if item is None:
+                            return
+                        yield item
+
+                try:
+                    with grpc.insecure_channel(fe1.endpoint) as channel:
+                        stub = vision_grpc.VisionAnalysisServiceStub(
+                            channel)
+                        responses = stub.AnalyzeActuatorPerformance(
+                            gen(), timeout=300)
+                        while not stop_load.is_set():
+                            outbox.put(request)
+                            counts["sent"] += 1
+                            resp = next(responses)
+                            if resp.status.startswith(
+                                    ("OK", "DEGRADED")):
+                                counts["acked"] += 1
+                            stop_load.wait(
+                                rng.expovariate(LOAD_RATE_HZ))
+                        outbox.put(None)
+                        for _ in responses:
+                            pass
+                except Exception:  # noqa: BLE001 - reopen the stream
+                    time.sleep(0.2)
+
+        load_thread = threading.Thread(
+            target=poisson_load, name="poisson-load", daemon=True)
+        load_thread.start()
+
+        def scaled_up():
+            stats = _frontend_stats(fleet_lib, grpc, fe1.endpoint)
+            leased = [ep for ep, lease in stats.get("leases", {}).items()
+                      if lease.get("state") == "active"
+                      and ep != seed_ep]
+            if stats.get("live_replicas", 0) >= 2 and leased:
+                return leased
+            return None
+
+        leased = _wait(scaled_up, timeout_s=120)
+        if not leased:
+            return _fail(
+                "autoscaler never grew the fleet to 2 under load",
+                _frontend_stats(fleet_lib, grpc, fe1.endpoint))
+        spawned_ep = leased[0]
+        print(f"scale-up ok: {spawned_ep} self-registered "
+              f"(seed {seed_ep} untouched)")
+
+        # the SIBLING converges on the same member via gossip adoption
+        # (it was never the registrar)
+        def sibling_sees():
+            stats = _frontend_stats(fleet_lib, grpc, fe2.endpoint)
+            lease = stats.get("leases", {}).get(spawned_ep, {})
+            return (stats.get("live_replicas", 0) >= 2
+                    and lease.get("state") == "active") or None
+
+        if not _wait(sibling_sees, timeout_s=60):
+            return _fail(
+                "sibling front-end never adopted the leased member",
+                _frontend_stats(fleet_lib, grpc, fe2.endpoint))
+        print("gossip ok: sibling front-end adopted the leased member")
+
+        metrics = _get(fe1.metrics_port, "/metrics")
+        if f'{families.AUTOSCALER_ACTIONS}{{action="scale_up"}}' \
+                not in metrics:
+            return _fail("rdp_autoscaler_actions_total{action="
+                         "\"scale_up\"} missing from /metrics")
+
+        # -- leg 2: SIGKILL a front-end mid-stream; retry on sibling --
+        chaos = {"sent": 0, "acked": 0}
+
+        def chaos_stream(endpoint: str, frames: int,
+                         kill_after: int | None) -> int:
+            """Serial send/ack stream; returns acked count. Raises
+            grpc.RpcError where the caller must fail over."""
+            outbox: queue.Queue = queue.Queue()
+
+            def gen():
+                while True:
+                    item = outbox.get()
+                    if item is None:
+                        return
+                    yield item
+
+            acked = 0
+            with grpc.insecure_channel(endpoint) as channel:
+                stub = vision_grpc.VisionAnalysisServiceStub(channel)
+                responses = stub.AnalyzeActuatorPerformance(
+                    gen(), timeout=120)
+                for i in range(frames):
+                    outbox.put(request)
+                    chaos["sent"] += 1
+                    if kill_after is not None and i == kill_after:
+                        fe2.kill()  # SIGKILL, mid-stream, frame in flight
+                    resp = next(responses)
+                    if not resp.status.startswith(("OK", "DEGRADED")):
+                        raise RuntimeError(
+                            f"chaos frame errored: {resp.status}")
+                    acked += 1
+                    chaos["acked"] += 1
+                outbox.put(None)
+                for _ in responses:
+                    pass
+            return acked
+
+        pending = 4
+        try:
+            done = chaos_stream(fe2.endpoint, frames=4, kill_after=3)
+            pending -= done
+        except grpc.RpcError:
+            pending = chaos["sent"] - chaos["acked"]
+        if fe2.alive():
+            return _fail("front-end survived its SIGKILL")
+        if pending > 0:
+            # the unacked in-flight frames resume on the sibling: the
+            # retry is the CLIENT's (stateless front-ends share nothing
+            # but gossip), and no accepted frame may be lost
+            chaos_stream(fe1.endpoint, frames=pending, kill_after=None)
+        if chaos["acked"] < 4:
+            return _fail(f"lost accepted frames: {chaos}")
+        print(f"front-end chaos ok: {chaos['acked']}/4 frames accepted "
+              f"across the SIGKILL (retried {max(pending, 0)} on the "
+              "sibling)")
+
+        # the killed front-end's journal outlived it: post-mortem merge
+        out = subprocess.run(
+            [sys.executable,
+             str(Path(__file__).resolve().parent / "journal_tail.py"),
+             "--json", str(tmp / "fe-0.jsonl"), str(tmp / "fe-1.jsonl")],
+            capture_output=True, text=True, timeout=60)
+        if out.returncode != 0:
+            return _fail("journal_tail failed on the persisted "
+                         f"journals: {out.stderr}")
+        post_mortem = json.loads(out.stdout)
+        dead_events = [e for e in post_mortem
+                       if e.get("source", "").endswith("fe-1.jsonl")]
+        if not dead_events:
+            return _fail("SIGKILLed front-end left no persisted "
+                         "journal events")
+        print(f"post-mortem ok: {len(dead_events)} journal events from "
+              "the killed front-end via journal_tail")
+
+        # -- leg 3: cut the load -> graceful drain scale-down ---------
+        stop_load.set()
+        load_thread.join(timeout=30)
+
+        def scaled_down():
+            stats = _frontend_stats(fleet_lib, grpc, fe1.endpoint)
+            lease = stats.get("leases", {}).get(spawned_ep, {})
+            gone = lease.get("state") in (None, "left", "expired")
+            return (stats.get("live_replicas", 0) == 1
+                    and gone) or None
+
+        if not _wait(scaled_down, timeout_s=180):
+            return _fail(
+                "autoscaler never drained back to the seed after the "
+                "load cut", _frontend_stats(fleet_lib, grpc,
+                                            fe1.endpoint))
+        print(f"scale-down ok: {spawned_ep} drained and retired, "
+              f"seed {seed_ep} still serving")
+
+        metrics = _get(fe1.metrics_port, "/metrics")
+        if f'{families.AUTOSCALER_ACTIONS}{{action="scale_down"}}' \
+                not in metrics:
+            return _fail("rdp_autoscaler_actions_total{action="
+                         "\"scale_down\"} missing from /metrics")
+
+        # -- the whole round trip is one readable event stream --------
+        events = json.loads(
+            _get(fe1.metrics_port, "/debug/events?since=0"))["events"]
+        actions = [e["attrs"].get("action") for e in events
+                   if e["kind"] == event_kinds.AUTOSCALER_ACTION]
+        if "scale_up" not in actions or "scale_down" not in actions:
+            return _fail(f"autoscaler round trip not in /debug/events: "
+                         f"{actions}")
+        if not any(e["kind"] == event_kinds.PLANNER_PLAN
+                   for e in events):
+            return _fail("no planner.plan evidence in /debug/events")
+        lease_regs = [e for e in events
+                      if e["kind"] == event_kinds.FLEET_LEASE
+                      and e["attrs"].get("endpoint") == spawned_ep]
+        if not lease_regs:
+            return _fail("spawned member's lease transitions missing "
+                         "from /debug/events")
+        up_seq = min(e["seq"] for e in events
+                     if e["kind"] == event_kinds.AUTOSCALER_ACTION
+                     and e["attrs"].get("action") == "scale_up")
+        down_seq = max(e["seq"] for e in events
+                       if e["kind"] == event_kinds.AUTOSCALER_ACTION
+                       and e["attrs"].get("action") == "scale_down")
+        if not up_seq < down_seq:
+            return _fail("scale_up/scale_down out of causal order")
+
+        print("OK: lease-registered scale-up, gossip convergence, "
+              "SIGKILLed front-end with zero lost accepted frames + "
+              "post-mortem journal, drain-driven scale-down; "
+              f"round trip journaled (scale_up#{up_seq} < "
+              f"scale_down#{down_seq}); load stream "
+              f"acked {counts['acked']}/{counts['sent']}")
+        rc = 0
+        return rc
+    finally:
+        stop_load.set()
+        if load_thread is not None:
+            load_thread.join(timeout=10)
+        frontend_lib.stop_frontends(frontends)
+        replica_lib.stop_replicas(replicas)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

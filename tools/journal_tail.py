@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Load, merge, and pretty-print persisted event journals.
+
+``RDP_JOURNAL_PATH`` makes every process append its journal ring to a
+JSONL file (observability/journal.py:JournalFile) with one bounded
+rotation generation (``<path>.1``). This tool is the post-mortem half:
+point it at one file per fleet member and it reconstructs the fleet
+timeline -- rotation generation first, then the live file, all sources
+merged by ``(unix_ts, seq)`` exactly like the front-end's live
+``/debug/events`` aggregation -- so a SIGKILLed member's final moments
+are readable after the process (and its debug port) are gone.
+
+Usage::
+
+    python tools/journal_tail.py /tmp/replica-a.jsonl /tmp/fe.jsonl
+    python tools/journal_tail.py --json --kind autoscaler.action *.jsonl
+
+Exit 0 even when some files are missing (a crashed member may never
+have written one); exit 2 when NO events could be loaded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_journal_file(path: str) -> list[dict]:
+    """All events persisted under ``path``: the ``.1`` rotation
+    generation (older) first, then the live file. Missing files and
+    corrupt lines (a SIGKILL can truncate the final write) are skipped,
+    not fatal."""
+    events: list[dict] = []
+    for candidate in (path + ".1", path):
+        try:
+            text = Path(candidate).read_text(encoding="utf-8")
+        except OSError:
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail write
+            if isinstance(event, dict) and "kind" in event:
+                event.setdefault("source", path)
+                events.append(event)
+    return events
+
+
+def merge_journals(paths: list[str]) -> list[dict]:
+    """One fleet-wide timeline: every source's events sorted by wall
+    clock, with each source's own cursor breaking ties -- the same
+    ordering the front-end's fleet-wide /debug/events uses."""
+    merged: list[dict] = []
+    for path in paths:
+        merged.extend(load_journal_file(path))
+    merged.sort(key=lambda e: ((e.get("unix_ts") or 0.0),
+                               (e.get("seq") or 0)))
+    return merged
+
+
+def _format(event: dict) -> str:
+    attrs = event.get("attrs") or {}
+    attr_text = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+    who = ":".join(p for p in (event.get("host"), event.get("role")) if p)
+    parts = [
+        f"{event.get('unix_ts', 0.0):.3f}",
+        f"#{event.get('seq', 0)}",
+        who or "-",
+        event.get("kind", "?"),
+    ]
+    if event.get("message"):
+        parts.append(event["message"])
+    if attr_text:
+        parts.append(attr_text)
+    return "  ".join(parts)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge persisted RDP_JOURNAL_PATH JSONL journals "
+                    "into one fleet timeline.")
+    parser.add_argument("paths", nargs="+",
+                        help="journal files (each implies its .1 "
+                             "rotation generation)")
+    parser.add_argument("--kind", default="",
+                        help="only events whose kind contains this "
+                             "substring")
+    parser.add_argument("--json", action="store_true",
+                        help="emit merged events as one JSON array "
+                             "instead of text lines")
+    parser.add_argument("--limit", type=int, default=0,
+                        help="keep only the LAST N merged events")
+    args = parser.parse_args(argv)
+
+    merged = merge_journals(args.paths)
+    if args.kind:
+        merged = [e for e in merged if args.kind in (e.get("kind") or "")]
+    if args.limit > 0:
+        merged = merged[-args.limit:]
+    if not merged:
+        print("no events loaded", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(merged, indent=2, sort_keys=True))
+    else:
+        for event in merged:
+            print(_format(event))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
