@@ -120,12 +120,21 @@ def poisson_arrivals(rate_hz: float, duration_s: float,
 
 
 def trace_arrivals(path: str) -> list[float]:
-    """Replayed arrivals from a JSON array of inter-arrival gaps in
-    MILLISECONDS (the shape a production access log reduces to)."""
+    """Replayed arrivals from a recorded trace: either a bare JSON
+    array of inter-arrival gaps in MILLISECONDS (the shape a production
+    access log reduces to) or the object form
+    ``{"gaps_ms": [...], "models": [...]}`` that
+    ``tools/journal_to_trace.py`` writes and the fleet simulator
+    (``sim/workload.py``) replays -- one trace file drives both the
+    live bench and the sim. The open-loop bench is single-model, so
+    per-arrival model labels are ignored here."""
     gaps_ms = json.loads(Path(path).read_text())
+    if isinstance(gaps_ms, dict):
+        gaps_ms = gaps_ms.get("gaps_ms")
     if not isinstance(gaps_ms, list) or not gaps_ms:
         raise ValueError(f"{path}: expected a non-empty JSON array of "
-                         "inter-arrival milliseconds")
+                         "inter-arrival milliseconds (bare or under "
+                         "'gaps_ms')")
     out, t = [], 0.0
     for g in gaps_ms:
         t += float(g) / 1e3

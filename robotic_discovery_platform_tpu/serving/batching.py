@@ -656,6 +656,12 @@ class BatchDispatcher:
         model_label: display name of the DEFAULT model ("" key) in fault
             sites / metrics / placer keys -- the zoo's default entry
             name ("seg"); "default" when unset.
+        clock: injectable monotonic clock for every deadline decision
+            (submit deadline, unmeetable-deadline shed, coalescing
+            window) and the admission queue's headroom ordering -- one
+            time source end to end, so fake-clock tests and the sim
+            twin see the same deadlines the queue orders by. Profiling
+            timestamps stay on wall time.
     """
 
     def __init__(self, analyze_batch: Callable, window_ms: float = 2.0,
@@ -666,8 +672,18 @@ class BatchDispatcher:
                  router: DeviceRouter | None = None,
                  admission: str = "deadline",
                  flight_recorder: recorder_lib.FlightRecorder | None = None,
-                 placer=None, model_label: str = "default"):
+                 placer=None, model_label: str = "default",
+                 clock: Callable[[], float] = time.monotonic):
         self._analyze = analyze_batch
+        # one time source for every CONTROL decision (submit deadlines,
+        # unmeetable-deadline sheds, the coalescing window) AND the
+        # admission queue's headroom ordering. The queue always took an
+        # injectable clock; the dispatcher used to hardcode
+        # time.monotonic() around it, so an injected (fake/sim) clock
+        # skewed deadline_t against the queue's margin arithmetic.
+        # Profiling spans (submit_ns & friends) deliberately stay on
+        # wall time -- they measure the host, not the control plane.
+        self._clock = clock
         self._recorder = (flight_recorder if flight_recorder is not None
                           else recorder_lib.RECORDER)
         self._placer = placer
@@ -738,7 +754,7 @@ class BatchDispatcher:
         else:
             self._n_windows = 1
         self._q = DeadlineQueue(max_backlog, policy=admission,
-                                on_evict=self._on_evicted)
+                                on_evict=self._on_evicted, clock=clock)
         self._cq: queue.Queue[_Dispatch | None] = queue.Queue()
         self._chip_slots = [
             threading.Semaphore(self._max_inflight)
@@ -857,7 +873,7 @@ class BatchDispatcher:
         p = _Pending(frame_rgb, depth, _intrinsics_f32(intrinsics),
                      float(depth_scale), model=model,
                      trace_ctx=trace.current(),
-                     deadline_t=time.monotonic() + timeout)
+                     deadline_t=self._clock() + timeout)
         # enqueue under the lock stop() drains under: a submit either lands
         # BEFORE the drain (and is error-completed by it) or observes
         # stopped and raises -- it can never enqueue after the drain and
@@ -1108,7 +1124,7 @@ class BatchDispatcher:
             # make the segmenter's deadlines look meetable, nor the
             # reverse -- each model sheds on its own history only
             est = self.service_estimate.s_for(p.model) * self.deadline_safety
-            slack = p.deadline_t - time.monotonic()
+            slack = p.deadline_t - self._clock()
             if est > 0 and slack < est:
                 with self._inflight_lock:
                     if self._sheds_since_complete.get(p.model, 0) >= 8:
@@ -1140,9 +1156,9 @@ class BatchDispatcher:
             if self._admit(first):
                 break
         batch = [first]
-        deadline = time.monotonic() + self._window_s
+        deadline = self._clock() + self._window_s
         while len(batch) < self._max_batch:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - self._clock()
             if remaining <= 0:
                 break
             try:

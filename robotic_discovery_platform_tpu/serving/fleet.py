@@ -777,6 +777,17 @@ class PeerGossip:
         if self._thread is not None or not self.peers:
             return
         self._stop = threading.Event()
+        # Boot-time seed (registrar quorum hygiene): a front-end that
+        # (re)starts with an empty lease table would otherwise place
+        # blind for up to poll_s while members it never heard of renew
+        # elsewhere -- the ~1 TTL blind spot after a registrar restart.
+        # One synchronous round now adopts every sibling-advertised
+        # ACTIVE lease before the first stream is placed; adopt still
+        # never resurrects a lease THIS front-end saw expire or leave.
+        try:
+            self.poll_once()
+        except Exception:  # noqa: BLE001 - seed is best-effort
+            log.exception("boot-time gossip seed failed")
 
         def loop():
             while not self._stop.wait(self.poll_s):

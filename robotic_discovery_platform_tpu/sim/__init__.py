@@ -1,0 +1,30 @@
+"""Deterministic fleet simulator: a discrete-event twin of the control
+plane.
+
+`analysis/explore.py` proves the CORRECTNESS half of the control plane
+on a shared fake clock (exhaustive interleavings of a small alphabet);
+this package is the PERFORMANCE half. One seeded discrete-event engine
+(:mod:`.engine`) drives the REAL ``ReactiveController``,
+``CircuitBreaker``, ``FleetRouter``, ``LeaseRegistry``,
+``RolloutManager``, ``ZooPlacer``, and ``Autoscaler`` objects unmodified
+-- every one of them already takes an injectable clock -- while only the
+device ride is modeled, by a per-(model, placement, chips) service-time
+distribution fitted from LOADBENCH.json / PALLASBENCH.json
+(:mod:`.model`). Arrivals come from Poisson / diurnal generators or
+replayed traces in ``bench_load.py --trace``'s format (:mod:`.workload`),
+scenarios script correlated failures on the virtual clock
+(:mod:`.scenario`), and sweeps grid failure x load in seconds on CPU
+(:mod:`.sweep`), emitting the same journal events and LOADBENCH-shaped
+rows as the live harness. The sim is only trusted because
+:mod:`.calibrate` continuously proves its tails against the measured
+LOADBENCH rows in CI (Clockwork's bar, PAPERS.md: a predictable system
+is one whose simulated tails match its measured ones).
+"""
+
+from __future__ import annotations
+
+from robotic_discovery_platform_tpu.sim.engine import Engine, VirtualClock
+from robotic_discovery_platform_tpu.sim.model import ServiceTimeModel
+from robotic_discovery_platform_tpu.sim.scenario import Scenario
+
+__all__ = ["Engine", "VirtualClock", "ServiceTimeModel", "Scenario"]

@@ -1,0 +1,218 @@
+"""Service-time model: the ONE modeled piece of the twin.
+
+Everything else in the sim is the real object; the device ride --
+submit, coalesce, dispatch, D2H -- is replaced by a per-(model,
+placement, chips) latency distribution fitted from measured rows:
+
+- **LOADBENCH.json** supplies the shape: each no-error leg row carries
+  per-model p50/p99 under a recorded offered load, placement mode and
+  chip count. A lognormal is fitted per (leg, model) by quantile
+  matching (``mu = ln p50``, ``sigma = (ln p99 - ln p50) / z99``), the
+  standard heavy-tailed latency fit: the body sits on the median, the
+  tail is pinned to the measured p99.
+- **PALLASBENCH.json** supplies the precision scaling: the measured
+  tier is its recorded dtype (bfloat16 compute); other tiers scale by
+  the byte ratio, weighted by the fraction of kernel rows that are
+  memory-bound (a bandwidth-bound kernel pays the full byte ratio, a
+  compute-bound one pays the MXU issue-rate ratio -- both ~2x bf16->f32
+  on this hardware, so the blend stays near the byte ratio).
+
+The fitted distribution is the frame's SOJOURN at the recorded
+operating point (it already contains the live harness's queueing at
+that load); the sim's capacity layer (slots = chips x slots_per_chip)
+therefore only adds delay when offered load exceeds the calibrated
+point -- queueing beyond the measurement EMERGES from the event queue
+rather than being baked into the sample. :mod:`.calibrate` holds this
+honest: replaying each row's recorded arrival process must reproduce
+its p50/p99/violation-rate within declared tolerance.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable
+
+#: standard normal quantile at 0.99: the p50->p99 span in sigmas
+_Z99 = 2.3263478740408408
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_LOADBENCH = _REPO_ROOT / "LOADBENCH.json"
+DEFAULT_PALLASBENCH = _REPO_ROOT / "PALLASBENCH.json"
+
+
+@dataclass(frozen=True)
+class FittedService:
+    """One fitted lognormal: latency seconds for ``model`` under
+    ``placement`` on ``chips`` chips, valid around ``offered_rps``."""
+
+    model: str
+    leg: str
+    placement: str
+    chips: int
+    offered_rps: float
+    p50_ms: float
+    p99_ms: float
+    mu: float      # ln seconds
+    sigma: float
+
+    @staticmethod
+    def from_quantiles(model: str, leg: str, placement: str, chips: int,
+                       offered_rps: float, p50_ms: float,
+                       p99_ms: float) -> "FittedService":
+        p50_ms = max(1e-3, float(p50_ms))
+        p99_ms = max(p50_ms, float(p99_ms))
+        mu = math.log(p50_ms / 1e3)
+        sigma = max(1e-6, (math.log(p99_ms) - math.log(p50_ms)) / _Z99)
+        return FittedService(model=model, leg=leg, placement=placement,
+                             chips=int(chips), offered_rps=float(offered_rps),
+                             p50_ms=p50_ms, p99_ms=p99_ms,
+                             mu=mu, sigma=sigma)
+
+
+def _precision_factors(pallas_path: os.PathLike | str | None) -> dict:
+    """dtype -> service-time multiplier relative to the measured tier.
+
+    bf16 is 1.0 by construction (it is what PALLASBENCH measured). f32
+    doubles bytes moved AND halves MXU issue rate, so both the
+    memory-bound and compute-bound fractions of the workload pay ~2x;
+    int8 is the symmetric half-cost tier. When PALLASBENCH is readable
+    the memory-bound fraction is recorded alongside for transparency,
+    but the blend lands on the byte ratio either way.
+    """
+    factors = {"bf16": 1.0, "bfloat16": 1.0, "f32": 2.0, "float32": 2.0,
+               "int8": 0.5}
+    if pallas_path is None:
+        return factors
+    try:
+        data = json.loads(Path(pallas_path).read_text())
+    except (OSError, ValueError):
+        return factors
+    rows = data.get("conv3x3") or []
+    bound = [r.get("bound_by") for r in rows if r.get("bound_by")]
+    if bound:
+        factors["memory_bound_fraction"] = (
+            bound.count("memory") / len(bound))
+    return factors
+
+
+class ServiceTimeModel:
+    """Every fitted entry, with placement/chips-aware lookup."""
+
+    def __init__(self, entries: Iterable[FittedService],
+                 precision_factors: dict | None = None,
+                 slo_ms: float = 250.0, chips: int = 4):
+        self.entries = list(entries)
+        if not self.entries:
+            raise ValueError("service-time model needs at least one "
+                             "fitted entry (is LOADBENCH.json empty?)")
+        self.precision_factors = dict(precision_factors or
+                                      _precision_factors(None))
+        self.slo_ms = float(slo_ms)
+        self.chips = int(chips)
+        self._by_key: dict[tuple, list[FittedService]] = {}
+        for e in self.entries:
+            self._by_key.setdefault((e.model, e.placement), []).append(e)
+        for v in self._by_key.values():
+            v.sort(key=lambda e: e.offered_rps)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def fit_loadbench(cls, path: os.PathLike | str = DEFAULT_LOADBENCH,
+                      pallas_path: os.PathLike | str | None =
+                      DEFAULT_PALLASBENCH) -> "ServiceTimeModel":
+        """Fit one entry per (no-error leg, active model) of a
+        LOADBENCH file. The fault leg is excluded: its latencies are
+        survivor-biased (every aux frame errored), so it would teach the
+        model that faults are fast."""
+        data = json.loads(Path(path).read_text())
+        entries: list[FittedService] = []
+        chips = 4
+        for row in data.get("rows") or []:
+            if row.get("errors"):
+                continue
+            leg = str(row.get("multimodel_leg") or row.get("leg") or "row")
+            placement = str(row.get("placement") or "shared")
+            chips = int(row.get("chips") or chips)
+            models = row.get("models") or {"": row}
+            for model, sub in models.items():
+                if not sub or not sub.get("n") or sub.get("errors"):
+                    continue
+                if sub.get("p50_ms") is None or sub.get("p99_ms") is None:
+                    continue
+                entries.append(FittedService.from_quantiles(
+                    model=str(model), leg=leg, placement=placement,
+                    chips=chips,
+                    offered_rps=float(sub.get("offered_rps") or 0.0),
+                    p50_ms=sub["p50_ms"], p99_ms=sub["p99_ms"]))
+        return cls(entries,
+                   precision_factors=_precision_factors(pallas_path),
+                   slo_ms=float(data.get("slo_ms") or 250.0), chips=chips)
+
+    @classmethod
+    def synthetic(cls, models: tuple[str, ...] = ("seg", "aux"),
+                  p50_ms: float = 40.0, p99_ms: float = 160.0,
+                  slo_ms: float = 250.0, chips: int = 4,
+                  ) -> "ServiceTimeModel":
+        """A stand-in fit for hosts without bench files (fresh clones,
+        unit tests): plausible smoke-bench-shaped tails, clearly labeled
+        synthetic so calibration refuses to bless it."""
+        entries = [
+            FittedService.from_quantiles(
+                model=m, leg="synthetic", placement="shared", chips=chips,
+                offered_rps=30.0, p50_ms=p50_ms * (1.0 + 0.2 * i),
+                p99_ms=p99_ms * (1.0 + 0.2 * i))
+            for i, m in enumerate(models)
+        ]
+        return cls(entries, slo_ms=slo_ms, chips=chips)
+
+    # -- lookup / sampling ---------------------------------------------------
+
+    def models(self) -> tuple[str, ...]:
+        return tuple(sorted({e.model for e in self.entries}))
+
+    def lookup(self, model: str, placement: str = "shared",
+               ) -> FittedService:
+        """Best entry for (model, placement): exact placement match
+        first, then any placement, preferring the LOWEST-load fit (least
+        queueing baked in -- capacity delay is the sim's to add)."""
+        for key in ((model, placement), (model, "shared"),
+                    (model, "dedicated")):
+            if key in self._by_key:
+                return self._by_key[key][0]
+        any_model = sorted(self._by_key)
+        if not any_model:  # pragma: no cover - constructor forbids
+            raise KeyError(model)
+        return self._by_key[any_model[0]][0]
+
+    def precision_factor(self, precision: str) -> float:
+        return float(self.precision_factors.get(precision, 1.0))
+
+    def sample_s(self, rng, model: str, *, placement: str = "shared",
+                 precision: str = "bf16", scale: float = 1.0) -> float:
+        """One latency draw in seconds. ``scale`` is the scenario hook
+        (brownouts multiply it); draws consume exactly one rng variate
+        so the schedule stays a pure function of the seed."""
+        fit = self.lookup(model, placement)
+        s = rng.lognormvariate(fit.mu, fit.sigma)
+        return s * self.precision_factor(precision) * max(1e-6, scale)
+
+    def mean_s(self, model: str, *, placement: str = "shared",
+               precision: str = "bf16") -> float:
+        """Analytic lognormal mean: the planner/capacity-side estimate."""
+        fit = self.lookup(model, placement)
+        return (math.exp(fit.mu + fit.sigma ** 2 / 2.0)
+                * self.precision_factor(precision))
+
+    def goodput_rps(self, *, placement: str = "shared",
+                    slots: int = 8) -> float:
+        """Aggregate sustainable rate across models for a replica with
+        ``slots`` concurrent service slots -- the CapacityModel-shaped
+        number the sim's planner wiring feeds to ``plan()``."""
+        mean = max(self.mean_s(m, placement=placement)
+                   for m in self.models())
+        return slots / mean

@@ -1,0 +1,541 @@
+"""Fleet simulator (PR 18): deterministic discrete-event twin of the
+control plane.
+
+- engine: virtual clock protocol, tie order, reentrant sleep, seeded
+  rng determinism;
+- workload: bench_load-shaped generators and the shared trace format
+  (round-trips through BOTH bench_load.trace_arrivals and
+  sim.workload.from_trace);
+- metrics: sim row summaries are key-for-key identical to
+  bench_load.summarize_level;
+- the twin: same seed + scenario => byte-identical event logs; the
+  scripted fault menu drives the REAL routers/registries/controllers/
+  rollout manager (journal events from the real objects land in the sim
+  log); the calibration gate reproduces every no-error LOADBENCH leg's
+  p50/p99/violation-rate within tolerance; the 3x3 failure x load sweep
+  completes with zero real sleeps;
+- satellites: PeerGossip's boot-time seed closes the registrar-restart
+  blind spot (fake clock, no waiting); BatchDispatcher deadline
+  arithmetic honors an injected clock end to end;
+- journal_to_trace: envelope and direct reconstruction, output readable
+  by both replay harnesses.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+import bench_load  # noqa: E402
+import journal_to_trace  # noqa: E402
+from robotic_discovery_platform_tpu.serving import fleet as fleet_lib  # noqa: E402
+from robotic_discovery_platform_tpu.serving.batching import (  # noqa: E402
+    BatchDispatcher,
+)
+from robotic_discovery_platform_tpu.sim import (  # noqa: E402
+    calibrate as calibrate_lib,
+    metrics as sim_metrics,
+    sweep as sweep_lib,
+    workload,
+)
+from robotic_discovery_platform_tpu.sim.cluster import (  # noqa: E402
+    SimConfig,
+    SimFleet,
+)
+from robotic_discovery_platform_tpu.sim.engine import (  # noqa: E402
+    Engine,
+    VirtualClock,
+)
+from robotic_discovery_platform_tpu.sim.model import (  # noqa: E402
+    DEFAULT_LOADBENCH,
+    FittedService,
+    ServiceTimeModel,
+)
+from robotic_discovery_platform_tpu.sim.scenario import Scenario  # noqa: E402
+
+_HAVE_LOADBENCH = DEFAULT_LOADBENCH.exists()
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_clock_is_the_injectable_protocol():
+    clock = VirtualClock(5.0)
+    assert clock() == 5.0
+    clock.t = 9.25
+    assert clock() == 9.25
+
+
+def test_engine_runs_events_in_time_then_schedule_order():
+    eng = Engine(seed=0)
+    order = []
+    eng.at(2.0, lambda: order.append("b"))
+    eng.at(1.0, lambda: order.append("a"))
+    eng.at(2.0, lambda: order.append("c"))  # same t: scheduling order
+    eng.run_until(10.0)
+    assert order == ["a", "b", "c"]
+    assert eng.now() == 10.0  # lands exactly on the horizon
+
+
+def test_engine_sleep_is_reentrant():
+    """A handler that calls engine.sleep (the RolloutManager idiom)
+    observes the world advancing underneath it."""
+    eng = Engine(seed=0)
+    seen = []
+
+    def waiter():
+        eng.sleep(5.0)
+        seen.append(("woke", eng.now(), tuple(ticks)))
+
+    ticks = []
+    eng.every(1.0, lambda: ticks.append(eng.now()))
+    eng.at(0.5, waiter)
+    eng.run_until(10.0)
+    woke = seen[0]
+    assert woke[1] == 5.5
+    # the periodic ticks due inside the slept window already ran
+    assert [t for t in woke[2]] == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_engine_rng_is_seed_deterministic():
+    a = [Engine(seed=3).rng.random() for _ in range(1)]
+    b = [Engine(seed=3).rng.random() for _ in range(1)]
+    c = [Engine(seed=4).rng.random() for _ in range(1)]
+    assert a == b != c
+
+
+# ---------------------------------------------------------------------------
+# service-time model
+# ---------------------------------------------------------------------------
+
+
+def test_fit_quantiles_pins_p50_and_p99():
+    fit = FittedService.from_quantiles("seg", "leg", "shared", 4,
+                                       30.0, 50.0, 200.0)
+    import math
+    assert math.exp(fit.mu) == pytest.approx(0.05)
+    # one sigma-span check: quantile function at 0.99 returns p99
+    assert math.exp(fit.mu + 2.3263478740408408 * fit.sigma) \
+        == pytest.approx(0.2)
+
+
+def test_sample_consumes_exactly_one_draw():
+    model = ServiceTimeModel.synthetic()
+    import random
+    r1, r2 = random.Random(11), random.Random(11)
+    model.sample_s(r1, "seg")
+    r2.lognormvariate(0.0, 1.0)
+    assert r1.random() == r2.random()  # streams advanced identically
+
+
+def test_precision_factors_scale_service_time():
+    model = ServiceTimeModel.synthetic()
+    import random
+    s_bf16 = model.sample_s(random.Random(5), "seg", precision="bf16")
+    s_f32 = model.sample_s(random.Random(5), "seg", precision="f32")
+    s_int8 = model.sample_s(random.Random(5), "seg", precision="int8")
+    assert s_f32 == pytest.approx(2.0 * s_bf16)
+    assert s_int8 == pytest.approx(0.5 * s_bf16)
+
+
+@pytest.mark.skipif(not _HAVE_LOADBENCH, reason="no LOADBENCH.json")
+def test_fit_loadbench_excludes_fault_leg():
+    model = ServiceTimeModel.fit_loadbench()
+    assert model.entries
+    assert all(e.leg != "fault" for e in model.entries)
+
+
+# ---------------------------------------------------------------------------
+# workload + the shared trace format
+# ---------------------------------------------------------------------------
+
+
+def test_modulated_poisson_concentrates_in_active_half():
+    import random
+    sched = workload.modulated_poisson(40.0, 40.0, 4.0, 0.0,
+                                       random.Random(0))
+    active = sum(1 for t, _ in sched if (t / 4.0) % 1.0 < 0.5)
+    assert active / len(sched) > 0.8  # peak_frac=0.9 minus noise
+
+
+def test_trace_round_trip_through_both_harnesses(tmp_path):
+    import random
+    sched = workload.multimodel(("seg", "aux"), 20.0, 4.0, 2.0,
+                                random.Random(1))
+    path = tmp_path / "trace.json"
+    workload.dump_trace(str(path), sched)
+    # sim replay reproduces offsets and labels
+    back = workload.from_trace(str(path))
+    assert len(back) == len(sched)
+    assert [m for _, m in back] == [m for _, m in sched]
+    assert all(abs(a[0] - b[0]) < 1e-5 for a, b in zip(back, sched))
+    # the live bench reads the SAME file (object form)
+    arrivals = bench_load.trace_arrivals(str(path))
+    assert len(arrivals) == len(sched)
+    assert arrivals[-1] == pytest.approx(sched[-1][0], abs=1e-5)
+
+
+def test_trace_bare_array_still_accepted(tmp_path):
+    path = tmp_path / "bare.json"
+    path.write_text("[100.0, 50.0, 50.0]")
+    assert bench_load.trace_arrivals(str(path)) == \
+        pytest.approx([0.1, 0.15, 0.2])
+    sched = workload.from_trace(str(path), default_model="seg")
+    assert [m for _, m in sched] == ["seg"] * 3
+
+
+def test_trace_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    with pytest.raises(ValueError):
+        workload.load_trace(str(bad))
+    with pytest.raises(ValueError):
+        bench_load.trace_arrivals(str(bad))
+    mismatch = tmp_path / "mismatch.json"
+    mismatch.write_text(json.dumps({"gaps_ms": [1, 2], "models": ["a"]}))
+    with pytest.raises(ValueError):
+        workload.load_trace(str(mismatch))
+
+
+def test_sim_summarize_matches_bench_exactly():
+    rng = np.random.default_rng(9)
+    lat = list(rng.lognormal(4.0, 0.6, size=500))
+    ours = sim_metrics.summarize_level(lat, errors=7, offered_rps=33.3,
+                                       wall_s=15.0, slo_ms=250.0)
+    theirs = bench_load.summarize_level(lat, errors=7, offered_rps=33.3,
+                                        wall_s=15.0, slo_ms=250.0)
+    assert ours == theirs
+
+
+# ---------------------------------------------------------------------------
+# the twin: determinism, faults, calibration, sweep
+# ---------------------------------------------------------------------------
+
+
+def _drill_run(seed: int):
+    service = ServiceTimeModel.synthetic()
+    eng = Engine(seed=seed)
+    cfg = SimConfig(n_replicas=4, n_frontends=2, autoscale=True)
+    fleet = SimFleet(cfg, eng, service=service)
+    scenario = (Scenario("drill")
+                .kill_replicas(5.0, 1)
+                .kill_frontend(8.0, 0)
+                .lease_expire(12.0, 1)
+                .chip_quarantine(14.0, chips=2, duration_s=6.0)
+                .brownout(16.0, scale=3.0, duration_s=6.0)
+                .restart_frontend(20.0, 0)
+                .restart_replicas(24.0, 1)
+                .ramp(24.0, rate_hz=30.0, duration_s=4.0)
+                .drift_rec(28.0))
+    import random
+    sched = workload.diurnal(15.0, 40.0, 15.0, 30.0, eng.rng,
+                             models=("seg", "aux"))
+    return fleet.run(sched, 30.0, scenario=scenario)
+
+
+def test_same_seed_same_scenario_byte_identical_log():
+    a, b = _drill_run(21), _drill_run(21)
+    assert a.log_text == b.log_text
+    assert len(a.log_text.splitlines()) > 50  # a real run, not a stub
+    assert a.rows["__all__"] == b.rows["__all__"]
+
+
+def test_different_seed_diverges():
+    assert _drill_run(21).log_text != _drill_run(22).log_text
+
+
+def test_scenario_drives_the_real_control_objects():
+    """The drill's observable record comes from the REAL components:
+    journal events (fleet.lease / fleet.membership / planner.plan)
+    re-stamped on virtual time, breaker-driven failovers, and a full
+    rollout cycle that ends promoted."""
+    res = _drill_run(33)
+    kinds = {line.split(" ", 2)[1] for line in res.log_text.splitlines()}
+    assert "journal:fleet.lease" in kinds
+    assert "journal:planner.plan" in kinds
+    assert "scenario.kill_replicas" in kinds
+    assert "replica.kill" in kinds
+    rollout_lines = [ln for ln in res.log_text.splitlines()
+                     if " scenario.rollout_cycle " in ln]
+    assert rollout_lines
+    assert json.loads(rollout_lines[0].split(" ", 2)[2])["outcome"] \
+        == "promoted"
+    # faults happened and the fleet still served the horizon (the
+    # autoscaler is free to have changed the live count)
+    assert res.rows["__all__"]["n"] > 0
+    assert res.counters["replicas_live"] >= 3
+
+
+def test_frame_failover_reroutes_on_replica_kill():
+    service = ServiceTimeModel.synthetic()
+    eng = Engine(seed=5)
+    fleet = SimFleet(SimConfig(n_replicas=3, n_frontends=1), eng,
+                     service=service)
+    scenario = Scenario("kill").kill_replicas(4.0, 1)
+    import random
+    sched = workload.poisson(30.0, 10.0, eng.rng)
+    res = fleet.run(sched, 10.0, scenario=scenario)
+    assert res.counters["failovers_total"] > 0
+    # rerouting kept the error rate far below the killed share
+    assert res.rows["__all__"]["errors"] < res.rows["__all__"]["n"] * 0.05
+
+
+def test_virtual_hours_in_wall_seconds():
+    """The point of the twin: an hour of fleet time in well under a
+    minute of CPU, with the controllers/registries/routers all real."""
+    service = ServiceTimeModel.synthetic()
+    eng = Engine(seed=2)
+    cfg = SimConfig(n_replicas=8, n_frontends=2, fleet_poll_s=10.0,
+                    gossip_poll_s=10.0, controller_tick_s=5.0,
+                    renew_every_s=10.0, lease_ttl_s=30.0)
+    fleet = SimFleet(cfg, eng, service=service)
+    sched = workload.diurnal(2.0, 10.0, 1800.0, 3600.0, eng.rng)
+    t0 = time.monotonic()
+    res = fleet.run(sched, 3600.0)
+    wall = time.monotonic() - t0
+    assert wall < 30.0
+    assert res.rows["__all__"]["n"] > 1000
+    assert res.counters["replicas_live"] == 8
+
+
+@pytest.mark.skipif(not _HAVE_LOADBENCH, reason="no LOADBENCH.json")
+def test_calibration_gate_reproduces_loadbench():
+    report = calibrate_lib.calibrate()
+    assert report["ok"], json.dumps(report, indent=2)
+    legs = {r["leg"] for r in report["rows"]}
+    assert {"baseline-seg", "baseline-aux", "multiplexed",
+            "dedicated"} <= legs
+    assert any(s["leg"] == "fault" for s in report["skipped"])
+    for row in report["rows"]:
+        for m, comp in row["models"].items():
+            assert comp["p50_ms"]["ok"] and comp["p99_ms"]["ok"], \
+                (row["leg"], m, comp)
+
+
+def test_calibration_refuses_empty_bench(tmp_path):
+    empty = tmp_path / "LOADBENCH.json"
+    empty.write_text(json.dumps({"slo_ms": 250.0, "rows": []}))
+    with pytest.raises(ValueError):
+        calibrate_lib.calibrate(empty, None)
+
+
+def test_sweep_grid_runs_with_zero_real_sleeps(monkeypatch):
+    def no_sleep(_s):
+        raise AssertionError("real time.sleep during a sim sweep")
+
+    monkeypatch.setattr(time, "sleep", no_sleep)
+    report = sweep_lib.sweep(
+        loadbench_path=Path("/nonexistent"),  # forces the synthetic fit
+        rates=(10.0, 20.0, 30.0), duration_s=8.0, period_s=4.0,
+        n_replicas=3, n_frontends=1)
+    assert report["synthetic_fit"] is True
+    assert len(report["rows"]) == 9  # 3 loads x 3 failure scenarios
+    for row in report["rows"]:
+        # LOADBENCH schema, plus the sweep cell identity
+        for key in ("offered_rps", "n", "errors", "p50_ms", "p99_ms",
+                    "violation_rate", "sweep"):
+            assert key in row
+        assert row["sweep"]["failure"] in (
+            "none", "replica-loss", "registrar-brownout")
+
+
+def test_scenario_spec_round_trip():
+    sc = (Scenario("x").kill_replicas(1.0, 2)
+          .brownout(2.0, scale=4.0, duration_s=3.0)
+          .restart_replicas(5.0, 2))
+    rebuilt = Scenario.from_spec(sc.to_spec())
+    assert rebuilt.to_spec() == sc.to_spec()
+    with pytest.raises(ValueError):
+        Scenario.from_spec([{"t": 1.0, "kind": "apply"}])
+    with pytest.raises(ValueError):
+        Scenario.from_spec([{"t": 1.0, "kind": "rm_rf"}])
+
+
+# ---------------------------------------------------------------------------
+# satellite: registrar quorum hygiene (gossip boot seed)
+# ---------------------------------------------------------------------------
+
+
+class _SiblingStub:
+    """A sibling front-end's stats RPC answered from a dict."""
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.calls = 0
+
+    def Get(self, request, timeout=None):  # noqa: N802 - gRPC surface
+        self.calls += 1
+        return json.dumps(self.payload).encode()
+
+
+def test_gossip_start_seeds_lease_table_before_first_interval():
+    """A restarted front-end's empty registry adopts every
+    sibling-advertised ACTIVE lease synchronously at start() -- no
+    waiting out poll_s, no placement blind spot. Fake clock: zero real
+    waiting anywhere."""
+    clock = FakeClock(100.0)
+    registry = fleet_lib.LeaseRegistry(ttl_s=10.0, clock=clock)
+    router = fleet_lib.FleetRouter([], clock=clock, registry=registry,
+                                   channel_factory=lambda ep: None)
+    gossip = fleet_lib.PeerGossip(
+        ["sibling:1"], registry=registry, router=router,
+        poll_s=3600.0,  # the interval alone can NOT explain adoption
+        channel_factory=lambda ep: None)
+    stub = _SiblingStub({
+        "leases": {
+            "replica-a:1": {"state": "active", "expires_in_s": 7.0,
+                            "metrics_port": 0, "version": "3"},
+            "replica-gone:1": {"state": "expired", "expires_in_s": 0.0},
+        },
+        "replica_loads": {},
+    })
+    gossip._stubs["sibling:1"] = stub
+    try:
+        assert registry.endpoints(fleet_lib.LEASE_ACTIVE) == []
+        gossip.start()
+        # adopted during start() itself, not after a poll interval
+        assert registry.state_of("replica-a:1") == fleet_lib.LEASE_ACTIVE
+        assert registry.state_of("replica-gone:1") is None
+        assert stub.calls == 1
+        assert gossip.adopted_total == 1
+    finally:
+        gossip.stop()
+        router.stop()
+
+
+def test_gossip_boot_seed_never_resurrects_expired(monkeypatch):
+    """The seed round goes through adopt(): a lease THIS front-end saw
+    expire stays dead even when a stale sibling still advertises it."""
+    clock = FakeClock(100.0)
+    registry = fleet_lib.LeaseRegistry(ttl_s=10.0, clock=clock)
+    router = fleet_lib.FleetRouter([], clock=clock, registry=registry,
+                                   channel_factory=lambda ep: None)
+    registry.register("replica-a:1")
+    registry.force_expire("replica-a:1")
+    registry.sweep()  # take the expiry edge before the seed round
+    gossip = fleet_lib.PeerGossip(
+        ["sibling:1"], registry=registry, router=router, poll_s=3600.0,
+        channel_factory=lambda ep: None)
+    gossip._stubs["sibling:1"] = _SiblingStub({
+        "leases": {"replica-a:1": {"state": "active",
+                                   "expires_in_s": 9.0}},
+        "replica_loads": {},
+    })
+    try:
+        gossip.start()
+        assert registry.state_of("replica-a:1") == fleet_lib.LEASE_EXPIRED
+        assert gossip.adopted_total == 0
+    finally:
+        gossip.stop()
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: BatchDispatcher deadline arithmetic on an injected clock
+# ---------------------------------------------------------------------------
+
+
+def _sum_analyze():
+    def analyze(frames, depths, intr, scales):
+        return {"sum": np.asarray(
+            [int(f.reshape(-1).sum()) for f in np.asarray(frames)])}
+
+    return analyze
+
+
+def test_batch_dispatcher_deadline_uses_injected_clock():
+    """Regression (wall-time sweep): submit() stamped deadline_t from
+    time.monotonic() while the DeadlineQueue it feeds could be on an
+    injected clock -- under a virtual clock far from wall time every
+    frame computed a wildly wrong slack. With the clock threaded
+    through, a dispatcher living at t=1e6 admits and serves normally."""
+    clock = FakeClock(1_000_000.0)  # nowhere near time.monotonic()
+    d = BatchDispatcher(_sum_analyze(), window_ms=1.0, max_batch=1,
+                        watchdog_interval_s=0.0, clock=clock)
+    try:
+        frame = np.ones((4, 4, 3), np.uint8)
+        depth = np.zeros((4, 4), np.uint16)
+        out = d.submit(frame, depth, np.eye(3, dtype=np.float32),
+                       0.001, timeout_s=5.0)
+        assert int(out["sum"]) == frame.sum()
+    finally:
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: journal_to_trace
+# ---------------------------------------------------------------------------
+
+
+def _journal_file(tmp_path, events):
+    path = tmp_path / "journal.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return str(path)
+
+
+def test_journal_to_trace_envelope_mode(tmp_path):
+    events = [{"kind": "planner.plan", "seq": i, "unix_ts": 100.0 + 5 * i,
+               "attrs": {"demand_rps": str(rate)}}
+              for i, rate in enumerate([40.0, 80.0, 20.0])]
+    src = _journal_file(tmp_path, events)
+    out = tmp_path / "trace.json"
+    rc = journal_to_trace.main([src, "--out", str(out), "--seed", "3",
+                                "--models", "seg,aux"])
+    assert rc == 0
+    gaps_ms, models = workload.load_trace(str(out))
+    assert models and set(models) == {"seg", "aux"}
+    span_s = sum(gaps_ms) / 1e3
+    assert 10.0 < span_s < 16.0  # two 5s knots + ~5s tail
+    # mean rate lands in the envelope's range
+    assert 20.0 < len(gaps_ms) / span_s < 80.0
+    # deterministic given the seed
+    out2 = tmp_path / "trace2.json"
+    journal_to_trace.main([src, "--out", str(out2), "--seed", "3",
+                           "--models", "seg,aux"])
+    assert out.read_text() == out2.read_text()
+    # and the live bench can replay the same file
+    assert bench_load.trace_arrivals(str(out))
+
+
+def test_journal_to_trace_direct_mode(tmp_path):
+    events = [{"kind": "fleet.failover", "seq": i,
+               "unix_ts": 50.0 + 0.25 * i, "attrs": {"model": "seg"}}
+              for i in range(8)]
+    src = _journal_file(tmp_path, events)
+    out = tmp_path / "direct.json"
+    rc = journal_to_trace.main([src, "--out", str(out),
+                                "--direct-kind", "fleet.failover"])
+    assert rc == 0
+    gaps_ms, models = workload.load_trace(str(out))
+    assert len(gaps_ms) == 8
+    assert gaps_ms[1:] == pytest.approx([250.0] * 7)
+    assert models == ["seg"] * 8
+
+
+def test_journal_to_trace_no_signal_is_an_error(tmp_path):
+    src = _journal_file(tmp_path, [{"kind": "fleet.lease", "seq": 0,
+                                    "unix_ts": 1.0, "attrs": {}}])
+    rc = journal_to_trace.main([src, "--out",
+                                str(tmp_path / "never.json")])
+    assert rc == 2
+    assert not (tmp_path / "never.json").exists()

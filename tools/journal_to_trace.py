@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Convert persisted event journals into an arrival trace.
+
+The shared trace format -- ``{"gaps_ms": [...], "models": [...]}`` (or a
+bare JSON array of gaps for single-model traces) -- is consumed by BOTH
+load harnesses: ``bench_load.py --trace`` replays it against a live
+server, ``robotic_discovery_platform_tpu.sim.workload.from_trace``
+replays it through the fleet simulator. This tool closes the loop from
+production to either one: point it at the ``RDP_JOURNAL_PATH`` JSONL
+files of a real fleet and it reconstructs what the fleet was asked to
+serve, so yesterday's incident can be replayed under the sim's scripted
+faults or tomorrow's canary bench.
+
+Two reconstruction modes:
+
+- **Envelope (default).** Frames are deliberately not journaled (too
+  hot), but every ``planner.plan`` event records the demand meter's
+  ``demand_rps``. The envelope mode treats consecutive plan events as a
+  piecewise-constant rate function and synthesizes a seeded Poisson
+  process through it -- statistically faithful arrivals, deterministic
+  given ``--seed``.
+- **Direct (``--direct-kind``).** When a deployment journals one event
+  per arrival-like occurrence (drills, replayed benches), each matching
+  event becomes one arrival at its ``unix_ts``, with the model label
+  read from ``--model-attr``.
+
+Usage::
+
+    python tools/journal_to_trace.py /tmp/fe-*.jsonl --out trace.json
+    python tools/journal_to_trace.py drill.jsonl --direct-kind \\
+        fleet.failover --out failover_replay.json
+    bench_load.py --trace trace.json ...     # live replay
+    # sim replay: workload.from_trace("trace.json")
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from journal_tail import merge_journals  # noqa: E402
+
+PLAN_KIND = "planner.plan"
+
+
+def demand_envelope(events: list[dict], kind: str = PLAN_KIND,
+                    ) -> list[tuple[float, float]]:
+    """(unix_ts, demand_rps) knots from the planner's journal trail,
+    time-sorted. Events without a parsable demand are skipped."""
+    knots: list[tuple[float, float]] = []
+    for ev in events:
+        if ev.get("kind") != kind:
+            continue
+        ts = ev.get("unix_ts")
+        attrs = ev.get("attrs") or {}
+        try:
+            demand = float(attrs.get("demand_rps"))
+            ts = float(ts)
+        except (TypeError, ValueError):
+            continue
+        knots.append((ts, demand))
+    knots.sort()
+    return knots
+
+
+def synthesize_from_envelope(knots: list[tuple[float, float]], *,
+                             seed: int = 0,
+                             models: list[str] | None = None,
+                             tail_s: float | None = None,
+                             ) -> tuple[list[float], list[str] | None]:
+    """Seeded Poisson arrivals through a piecewise-constant rate
+    envelope. The final knot's rate runs for ``tail_s`` (default: the
+    median knot spacing) so the last plan interval is represented."""
+    if len(knots) < 1:
+        raise ValueError("no demand knots: journals carry no "
+                         f"'{PLAN_KIND}' events with demand_rps")
+    rng = random.Random(seed)
+    spans = [b[0] - a[0] for a, b in zip(knots, knots[1:])]
+    if tail_s is None:
+        tail_s = sorted(spans)[len(spans) // 2] if spans else 1.0
+    segments = [(t, rate, (knots[i + 1][0] if i + 1 < len(knots)
+                           else t + tail_s))
+                for i, (t, rate) in enumerate(knots)]
+    t0 = segments[0][0]
+    arrivals: list[float] = []
+    for start, rate, end in segments:
+        if rate <= 0 or end <= start:
+            continue
+        t = start + rng.expovariate(rate)
+        while t < end:
+            arrivals.append(t - t0)
+            t += rng.expovariate(rate)
+    gaps_ms: list[float] = []
+    prev = 0.0
+    for t in arrivals:
+        gaps_ms.append(round((t - prev) * 1e3, 6))
+        prev = t
+    labels = None
+    if models:
+        labels = [models[i % len(models)] for i in range(len(gaps_ms))]
+    return gaps_ms, labels
+
+
+def direct_arrivals(events: list[dict], *, kind: str,
+                    model_attr: str = "model",
+                    default_model: str = "seg",
+                    ) -> tuple[list[float], list[str]]:
+    """One arrival per matching journal event, gaps from wall-clock
+    deltas."""
+    hits = sorted(((float(ev["unix_ts"]), ev) for ev in events
+                   if ev.get("kind") == kind
+                   and ev.get("unix_ts") is not None),
+                  key=lambda pair: pair[0])
+    if not hits:
+        raise ValueError(f"no '{kind}' events in the supplied journals")
+    gaps_ms: list[float] = []
+    labels: list[str] = []
+    prev = hits[0][0]
+    for ts, ev in hits:
+        gaps_ms.append(round((ts - prev) * 1e3, 6))
+        prev = ts
+        attrs = ev.get("attrs") or {}
+        labels.append(str(attrs.get(model_attr) or default_model))
+    return gaps_ms, labels
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Reconstruct an arrival trace (bench_load --trace / "
+                    "sim.workload format) from RDP_JOURNAL_PATH JSONL "
+                    "files.")
+    ap.add_argument("journals", nargs="+",
+                    help="journal JSONL paths (rotation .1 generations "
+                         "are picked up automatically)")
+    ap.add_argument("--out", required=True, help="trace file to write")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="envelope mode: Poisson synthesis seed")
+    ap.add_argument("--models", default="",
+                    help="envelope mode: comma-separated model labels "
+                         "to round-robin over (empty = no labels)")
+    ap.add_argument("--tail-s", type=float, default=None,
+                    help="envelope mode: how long the final demand knot "
+                         "runs (default: median knot spacing)")
+    ap.add_argument("--direct-kind", default="",
+                    help="direct mode: journal kind to treat as one "
+                         "arrival per event")
+    ap.add_argument("--model-attr", default="model",
+                    help="direct mode: attr carrying the model label")
+    ap.add_argument("--default-model", default="seg")
+    args = ap.parse_args(argv)
+
+    events = merge_journals(args.journals)
+    if not events:
+        print("no events loaded from any journal", file=sys.stderr)
+        return 2
+    try:
+        if args.direct_kind:
+            gaps_ms, labels = direct_arrivals(
+                events, kind=args.direct_kind,
+                model_attr=args.model_attr,
+                default_model=args.default_model)
+        else:
+            models = [m for m in args.models.split(",") if m]
+            gaps_ms, labels = synthesize_from_envelope(
+                demand_envelope(events), seed=args.seed,
+                models=models or None, tail_s=args.tail_s)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    payload: object = ({"gaps_ms": gaps_ms, "models": labels}
+                       if labels else gaps_ms)
+    Path(args.out).write_text(json.dumps(payload))
+    print(f"wrote {len(gaps_ms)} arrivals "
+          f"({sum(gaps_ms) / 1e3:.1f}s span) to {args.out}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""CI smoke for the fleet simulator: a 1000-replica, multi-hour drill.
+
+Simulates two virtual hours of diurnal traffic against a 1000-replica,
+3-front-end fleet -- the REAL routers, registries, breakers, controllers
+and gossip, only the device modeled -- with correlated faults scripted
+mid-run:
+
+- t+30min: two of three front-ends SIGKILLed at once (the quorum-loss
+  shape); restarted 10 virtual minutes later with EMPTY lease tables,
+  recovering through the boot-time gossip seed.
+- t+60min: 20 replicas killed in one instant (a rack loss), restarted
+  10 minutes later.
+
+Asserts the run is deterministic (two runs, byte-identical event logs,
+on a short prefix window), completes under the CPU budget, recovers to
+full membership, and keeps the violation rate bounded. Exits non-zero on any
+failure; CI runs it with RDP_LOCKCHECK=strict so every lock the real
+objects take under the sim is discipline-checked too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from robotic_discovery_platform_tpu.sim import workload  # noqa: E402
+from robotic_discovery_platform_tpu.sim.cluster import (  # noqa: E402
+    SimConfig,
+    SimFleet,
+)
+from robotic_discovery_platform_tpu.sim.engine import Engine  # noqa: E402
+from robotic_discovery_platform_tpu.sim.model import (  # noqa: E402
+    ServiceTimeModel,
+)
+from robotic_discovery_platform_tpu.sim.scenario import (  # noqa: E402
+    Scenario,
+)
+
+
+def build(seed: int, n_replicas: int, duration_s: float):
+    try:
+        service = ServiceTimeModel.fit_loadbench()
+    except (OSError, ValueError):
+        service = ServiceTimeModel.synthetic()
+    eng = Engine(seed=seed)
+    cfg = SimConfig(
+        n_replicas=n_replicas, n_frontends=3,
+        streams=2 * n_replicas,
+        fleet_poll_s=30.0, gossip_poll_s=30.0,
+        controller_tick_s=15.0, renew_every_s=30.0, lease_ttl_s=90.0)
+    fleet = SimFleet(cfg, eng, service=service)
+    t_fe = duration_s * 0.25
+    t_rep = duration_s * 0.5
+    scenario = (Scenario("ci-smoke")
+                .kill_frontend(t_fe, 0)
+                .kill_frontend(t_fe + 5.0, 1)
+                .restart_frontend(t_fe + 600.0, 0)
+                .restart_frontend(t_fe + 600.0, 1)
+                .kill_replicas(t_rep, 20)
+                .restart_replicas(t_rep + 600.0, 20))
+    sched = workload.diurnal(15.0, 80.0, duration_s / 2.0, duration_s,
+                             eng.rng, models=("seg", "aux"))
+    return fleet, sched, scenario
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=1000)
+    ap.add_argument("--duration-s", type=float, default=7200.0,
+                    help="virtual seconds (default: two hours)")
+    ap.add_argument("--budget-s", type=float, default=60.0,
+                    help="wall-clock CPU budget for the main run")
+    ap.add_argument("--determinism-window-s", type=float, default=120.0,
+                    help="virtual seconds for the two-run determinism "
+                         "check (kept short; the main run covers scale)")
+    ap.add_argument("--max-violation-rate", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=20260807)
+    args = ap.parse_args(argv)
+    logging.disable(logging.WARNING)  # membership chatter at 1000 replicas
+
+    failures: list[str] = []
+
+    # determinism first, on a short window: byte-identical logs
+    def short_run() -> str:
+        fleet, sched, scenario = build(args.seed, 50,
+                                       args.determinism_window_s)
+        res = fleet.run([a for a in sched
+                         if a[0] < args.determinism_window_s],
+                        args.determinism_window_s, scenario=scenario)
+        return res.log_text
+
+    if short_run() != short_run():
+        failures.append("determinism: two same-seed runs diverged")
+
+    t0 = time.time()
+    fleet, sched, scenario = build(args.seed, args.replicas,
+                                   args.duration_s)
+    res = fleet.run(sched, args.duration_s, scenario=scenario)
+    wall = time.time() - t0
+
+    row = res.rows["__all__"]
+    summary = {
+        "replicas": args.replicas,
+        "virtual_s": args.duration_s,
+        "wall_s": round(wall, 2),
+        "speedup": round(args.duration_s / wall, 1),
+        "events": res.counters["events_run"],
+        "arrivals": row["arrivals"],
+        "errors": row["errors"],
+        "p50_ms": row["p50_ms"],
+        "p99_ms": row["p99_ms"],
+        "violation_rate": row["violation_rate"],
+        "replicas_live": res.counters["replicas_live"],
+        "leases_active": res.counters["leases_active"],
+    }
+    print(json.dumps(summary, indent=2))
+
+    if wall > args.budget_s:
+        failures.append(f"CPU budget: {wall:.1f}s > {args.budget_s}s")
+    if res.counters["replicas_live"] != args.replicas:
+        failures.append(
+            f"recovery: {res.counters['replicas_live']} live replicas "
+            f"!= {args.replicas}")
+    if res.counters["leases_active"] != args.replicas:
+        failures.append(
+            f"recovery: {res.counters['leases_active']} active leases "
+            f"!= {args.replicas} (front-end restarts did not re-adopt)")
+    if row["violation_rate"] > args.max_violation_rate:
+        failures.append(
+            f"violation rate {row['violation_rate']} > "
+            f"{args.max_violation_rate}")
+    if row["arrivals"] == 0:
+        failures.append("no arrivals simulated")
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    print(f"sim-smoke: {'FAILED' if failures else 'OK'} "
+          f"({args.duration_s / 3600:.1f} virtual hours, "
+          f"{args.replicas} replicas, {wall:.1f}s wall)",
+          file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
