@@ -641,9 +641,11 @@ def run_fleet_mode(cli, slo_ms: float, deadline_s: float | None,
 
 # -- host-path profile -------------------------------------------------------
 
-#: the rdp_host_stage_split_seconds stages, handler order
-HOST_SPLIT_STAGES = ("decode", "admit", "stage_host", "h2d", "launch",
-                     "device", "d2h", "encode")
+#: the rdp_host_stage_split_seconds stages, handler order ("entropy" is
+#: the split-decode host half, observed alongside "decode" for
+#: format=coef frames -- NOT added into host_us, that would double-count)
+HOST_SPLIT_STAGES = ("decode", "entropy", "admit", "stage_host", "h2d",
+                     "launch", "device", "d2h", "encode")
 #: the "host-side per-frame microseconds" headline: decode work + pooled
 #: staging + the explicit H2D enqueue (what the ingest overhaul attacks)
 HOST_US_STAGES = ("decode", "stage_host", "h2d")
@@ -696,16 +698,22 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
                      load_spec, duration: float, frame_wh) -> None:
     """``--host-profile``: the ingest overhaul's before/after proof.
 
-    Two legs at the SAME offered load (same Poisson seed): ``before`` =
-    the pre-overhaul host path (inline decode in the handler thread,
-    JPEG/PNG wire payloads) and ``after`` = the overhauled path (decode
-    worker pool + raw-format zero-copy payloads). Each leg's per-frame
-    microseconds are split into decode / admit / stage-host / H2D /
-    launch / device / D2H / encode by diffing the in-process
+    Three legs at the SAME offered load (same Poisson seed): ``before``
+    = the pre-overhaul host path (inline decode in the handler thread,
+    JPEG/PNG wire payloads), ``after`` = the overhauled path (decode
+    worker pool + raw-format zero-copy payloads), and ``coef`` = the
+    split-decode wire (format=2 coefficient payloads; the host's whole
+    color decode is frombuffer views, dequant+IDCT+upsample+convert run
+    on-device ahead of the analyzer). Each leg's per-frame microseconds
+    are split into decode / entropy / admit / stage-host / H2D / launch
+    / device / D2H / encode by diffing the in-process
     ``rdp_host_stage_split_seconds`` and ``rdp_stage_latency_seconds``
-    families around the measured window, and both splits land in
-    LOADBENCH.json rows tagged ``host_leg``. The headline is the
-    reduction in host-side microseconds (decode + staging)."""
+    families around the measured window, and all splits land in
+    LOADBENCH.json rows tagged ``host_leg`` together with each leg's
+    ``wire_bytes_per_frame``. The headlines: the before->after reduction
+    in host-side microseconds (decode + staging) and the before->coef
+    reduction in host-side DECODE microseconds (the JPEG-wire leg's
+    imdecode cost vs the coefficient leg's byte routing)."""
     import grpc
 
     from robotic_discovery_platform_tpu.io.frames import SyntheticSource
@@ -718,9 +726,11 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
     after_workers = (cli.decode_workers if cli.decode_workers
                      else 4)
     legs = (("before", 0, "encoded"),
-            ("after", after_workers, "raw"))
+            ("after", after_workers, "raw"),
+            ("coef", after_workers, "coef"))
     rows: list[dict] = []
     profiles: dict[str, dict] = {}
+    wire_bytes: dict[str, int] = {}
     warm_errors = 0
     source = SyntheticSource(width=w, height=h, seed=cli.seed, n_frames=1)
     source.start()
@@ -743,6 +753,12 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
                 except Exception:
                     warm_errors += 1
             servicer.warmup(w, h)
+            if fmt == "coef":
+                # this leg's clients ship format=2 against a
+                # pixel-decode server: warm the coefficient-lane
+                # buckets too, or their first dispatches pay the fused
+                # decode+analyze compilation inside the measured window
+                servicer.warmup_coef(w, h)
             snap0 = _host_snapshot()
             arrivals = poisson_arrivals(
                 rate, duration, np.random.default_rng(cli.seed))
@@ -753,10 +769,13 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
             row["host_leg"] = name
             row["decode_workers"] = workers
             row["wire_format"] = fmt
+            row["wire_bytes_per_frame"] = request.ByteSize()
             row["host_profile"] = prof
             rows.append(row)
             profiles[name] = prof
+            wire_bytes[name] = request.ByteSize()
             print(f"# host leg={name} workers={workers} fmt={fmt} "
+                  f"wire={request.ByteSize()}B "
                   f"host_us={prof['host_us']} split={prof['split_us']}",
                   file=sys.stderr)
         finally:
@@ -765,6 +784,7 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
             servicer.close()
 
     before, after = profiles["before"], profiles["after"]
+    coef = profiles.get("coef")
     reduction = (1.0 - after["host_us"] / before["host_us"]
                  if before["host_us"] > 0 else 0.0)
     host_block = {
@@ -775,7 +795,25 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
         "host_us_before": before["host_us"],
         "host_us_after": after["host_us"],
         "reduction_pct": round(100.0 * reduction, 1),
+        "wire_bytes_per_frame": wire_bytes,
     }
+    if coef is not None:
+        # split-decode headline: the JPEG-wire leg's per-frame host
+        # DECODE microseconds (imdecode + cvtColor) vs the coefficient
+        # leg's (frombuffer views; the "entropy" stage is a labeled VIEW
+        # of the same work, not an addend) -- the number the CI
+        # decode-smoke gate reads
+        decode_before = before["split_us"]["decode"]
+        decode_coef = coef["split_us"]["decode"]
+        host_block["coef"] = coef
+        host_block["decode_us_before"] = decode_before
+        host_block["decode_us_coef"] = round(decode_coef, 2)
+        host_block["coef_decode_reduction_pct"] = round(
+            100.0 * (1.0 - decode_coef / decode_before)
+            if decode_before > 0 else 0.0, 1)
+        host_block["coef_host_reduction_pct"] = round(
+            100.0 * (1.0 - coef["host_us"] / before["host_us"])
+            if before["host_us"] > 0 else 0.0, 1)
 
     import jax
 
@@ -1289,6 +1327,13 @@ def main() -> None:
                              "server ('after' leg of --host-profile, "
                              "default 4 there; other smoke legs default "
                              "to 0 = the historical inline decode)")
+    parser.add_argument("--wire-format", default="encoded",
+                        choices=("encoded", "raw", "coef"),
+                        help="request wire format for the plain smoke "
+                             "legs (encoded = JPEG/PNG, raw = zero-copy "
+                             "RGB8/z16, coef = split-decode format=2 "
+                             "coefficient payloads); --host-profile "
+                             "sweeps all three itself")
     parser.add_argument("--deadline-ms", type=float, default=None,
                         help="per-request gRPC deadline (default: the "
                              "SLO itself -- a client with a 250ms "
@@ -1413,7 +1458,8 @@ def main() -> None:
                 source.start()
                 color, depth = source.get_frames()
                 source.stop()
-                request = client_lib.encode_request(color, depth)
+                request = client_lib.encode_request(
+                    color, depth, fmt=cli.wire_format)
             # warm phase, off the measured window: pays XLA compilation
             # for the single-frame bucket and ABSORBS any armed one-shot
             # fault (CI's graceful-degradation leg) -- errors are
@@ -1432,6 +1478,11 @@ def main() -> None:
                 # measured tail reflects serving, not one-off XLA
                 # compilation
                 servicer.warmup(w, h)
+                if cli.wire_format == "coef":
+                    # format=2 wire: the coefficient lane has its own
+                    # fused decode+analyze graphs per bucket -- warm
+                    # them too so the measured tail stays compile-free
+                    servicer.warmup_coef(w, h)
             if needs_capacity and capacity is None:
                 # anchor 'Nx' loads once, on the FIRST leg's server, so
                 # every leg sees the same absolute offered loads

@@ -345,6 +345,105 @@ def bench_geometry(rt_ms: float) -> list[dict]:
     return rows
 
 
+def bench_decode(rt_ms: float) -> list[dict]:
+    """Split-JPEG decode stage (ops/pallas/decode.py + ops/pipeline.py)
+    vs the XLA reference, at the serving frame shape (480x640 4:2:0).
+
+    Two row families: the fused dequant+IDCT launch alone (Pallas kernel
+    vs the XLA basis-matmul reference, both bitwise-identical so the race
+    is pure schedule), and the whole ``decode_coef_batch`` stage
+    (dequant+IDCT x3 planes + fancy upsample + color convert). The gate:
+    the whole-stage roofline must classify as bandwidth-bound (``bound_by
+    == "memory"``) -- on-chip decode rides the analyzer's HBM streams, it
+    must not compete for MXU time -- and this section asserts that, so a
+    flops.py regression that flips the classification fails the bench."""
+    from robotic_discovery_platform_tpu.ops import pipeline as pipeline_lib
+    from robotic_discovery_platform_tpu.ops.pallas import decode as pdecode
+    from robotic_discovery_platform_tpu.utils import flops as flops_lib
+
+    rng = np.random.default_rng(4)
+    rows = []
+    h, w = 480, 640
+    ybh, ybw = h // 8, w // 8          # 60 x 80 luma blocks
+    cbh, cbw = h // 16, w // 16        # 4:2:0 chroma grid
+
+    # fused dequant+IDCT alone: [B, N, 64] coefficients through the two
+    # basis matmuls; the output samples (0..255) level-shift back to a
+    # coefficient-shaped int16 feed, so the chain is data-dependent and
+    # shape-stable on both sides.
+    for b in (1, 8):
+        n = ybh * ybw
+        coefs = jnp.asarray(
+            rng.integers(-64, 64, (b, n, 64)), jnp.int16)
+        q = jnp.asarray(rng.integers(2, 24, (b, 64)), jnp.uint16)
+
+        def step_pallas(c, q=q):
+            y = pdecode.dequant_idct(c, q, impl="pallas")
+            return (y - 128).astype(jnp.int16)
+
+        def step_xla(c, q=q):
+            y = pdecode.dequant_idct(c, q, impl="xla")
+            return (y - 128).astype(jnp.int16)
+
+        t_p = _time_chain(step_pallas, coefs, rt_ms)
+        t_x = _time_chain(step_xla, coefs, rt_ms)
+        roof = flops_lib.jpeg_idct_roofline_ms(n, batch=b)
+        rows.append({
+            "op": "jpeg_dequant_idct", "b": b, "n_blocks": n,
+            "pallas_ms": round(t_p, 4), "xla_ms": round(t_x, 4),
+            "speedup": round(t_x / t_p, 3),
+            **_roofline_fields(roof, t_p, t_x),
+        })
+        print(f"# dequant_idct b{b} n{n}: pallas={t_p:.3f}ms "
+              f"xla={t_x:.3f}ms x{t_x / t_p:.2f} "
+              f"roof={roof['bound_ms']:.3f}ms ({roof['bound_by']})",
+              file=sys.stderr)
+
+    # whole decode stage: coefficients -> RGB. Feed the decoded luma
+    # channel back through the inverse block assembly as the next luma
+    # coefficient plane (chroma/quant ride as closed-over constants).
+    b = 8
+    ny, nc = ybh * ybw, cbh * cbw
+    y0 = jnp.asarray(rng.integers(-64, 64, (b, ny, 64)), jnp.int16)
+    cb0 = jnp.asarray(rng.integers(-32, 32, (b, nc, 64)), jnp.int16)
+    cr0 = jnp.asarray(rng.integers(-32, 32, (b, nc, 64)), jnp.int16)
+    qy = jnp.asarray(rng.integers(2, 24, (b, 64)), jnp.uint16)
+    qc = jnp.asarray(rng.integers(2, 32, (b, 64)), jnp.uint16)
+
+    def _decode_step(impl):
+        def step(y):
+            rgb = pipeline_lib.decode_coef_batch(
+                y, cb0, cr0, qy, qc, height=h, width=w,
+                subsampling="420", impl=impl)
+            lum = rgb[..., 0].astype(jnp.int32) - 128
+            blocks = lum.reshape(b, ybh, 8, ybw, 8).transpose(
+                0, 1, 3, 2, 4).reshape(b, ny, 64)
+            return blocks.astype(jnp.int16)
+        return step
+
+    t_p = _time_chain(_decode_step("pallas"), y0, rt_ms)
+    t_x = _time_chain(_decode_step("xla"), y0, rt_ms)
+    roof = flops_lib.jpeg_decode_roofline_ms(h, w, batch=b,
+                                             subsampling="420")
+    # the gate: on-chip decode must be bandwidth-bound at serving shapes
+    assert roof["bound_by"] == "memory", (
+        f"decode stage classified {roof['bound_by']!r}-bound at "
+        f"{h}x{w} b{b}; the split-decode design requires it to ride "
+        "the HBM streams (see utils/flops.jpeg_decode_roofline_ms)"
+    )
+    rows.append({
+        "op": "decode_coef_batch", "b": b, "h": h, "w": w,
+        "subsampling": "420",
+        "pallas_ms": round(t_p, 4), "xla_ms": round(t_x, 4),
+        "speedup": round(t_x / t_p, 3),
+        **_roofline_fields(roof, t_p, t_x),
+    })
+    print(f"# decode b{b} {h}x{w}: pallas={t_p:.3f}ms xla={t_x:.3f}ms "
+          f"x{t_x / t_p:.2f} roof={roof['bound_ms']:.3f}ms "
+          f"({roof['bound_by']})", file=sys.stderr)
+    return rows
+
+
 def bench_full_forward(rt_ms: float) -> dict:
     from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
     from robotic_discovery_platform_tpu.ops.pallas import make_pallas_unet
@@ -527,6 +626,7 @@ def main() -> None:
         "conv3x3": _section("conv3x3", bench_conv3x3, rt_ms),
         "heads": _section("heads", bench_heads, rt_ms),
         "geometry": _section("geometry", bench_geometry, rt_ms),
+        "decode": _section("decode", bench_decode, rt_ms),
         "full_forward_b1_256": _section(
             "full_forward", bench_full_forward, rt_ms),
         "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

@@ -262,7 +262,9 @@ DECODE_SECONDS = REGISTRY.histogram(
     families.DECODE_SECONDS,
     "Actual per-frame image-decode work (wherever it ran: decode worker "
     "or inline handler thread), by wire payload format (encoded = "
-    "JPEG/PNG imdecode, raw = zero-copy frombuffer view, mixed).",
+    "JPEG/PNG imdecode, raw = zero-copy frombuffer view, coef = "
+    "split-decode coefficient unpack -- frombuffer views only, the "
+    "pixel half runs on-device, mixed).",
     ("format",),
 )
 DECODE_QUEUE_DEPTH = REGISTRY.gauge(
@@ -285,7 +287,9 @@ GEOMETRY_CACHE_MISSES = REGISTRY.counter(
 HOST_STAGE_SPLIT = REGISTRY.histogram(
     families.HOST_STAGE_SPLIT,
     "Per-frame host/device split the --host-profile bench reads: decode "
-    "(actual decode work), admit (submit to collected), stage_host "
+    "(actual decode work), entropy (split-decode host half: coefficient "
+    "unpack or host entropy decode, observed alongside decode for "
+    "format=coef frames), admit (submit to collected), stage_host "
     "(pooled-buffer fill), h2d (explicit device_put staging), launch "
     "(async jit dispatch), device (launch to completer pop), d2h "
     "(blocking host fetch + fan-out), encode (response mask encode).",

@@ -297,6 +297,199 @@ def make_batch_analyzer(
     return transferguard.apply(analyze)
 
 
+# -- split JPEG decode: the device half ------------------------------------
+#
+# The host (serving/entropy.py) stops at quantized coefficient blocks;
+# everything below runs inside the SAME jit graph as the analyzer, so the
+# decoded RGB image never materializes on the host. Every stage mirrors
+# libjpeg's fixed-point arithmetic exactly (islow IDCT as two integer
+# basis matmuls in ops/pallas/decode.py, triangle "fancy" chroma
+# upsampling, SCALEBITS=16 YCbCr->RGB), which is what makes the end-to-end
+# split bitwise-comparable against cv2.imdecode in the golden tests.
+
+_YCC_SCALE = 16
+_YCC_HALF = 1 << (_YCC_SCALE - 1)
+
+
+def _ycc_fix(x: float) -> int:
+    return int(x * (1 << _YCC_SCALE) + 0.5)
+
+
+def stage_coef_batch(y, cb, cr, qy, qc, depths, intrinsics, depth_scales,
+                     device=None):
+    """:func:`stage_batch` for coefficient-wire batches.
+
+    Same explicit-H2D contract (pooled 64-byte-aligned staging buffers or
+    zero-copy ``np.frombuffer`` views in, device arrays out, async
+    ``device_put``); the payload is the entropy-decoded planes +
+    per-frame quant tables instead of an RGB image.
+    """
+    from jax.sharding import NamedSharding
+
+    if isinstance(device, NamedSharding):
+        b = int(np.shape(y)[0])
+        shards = device.mesh.shape.get("data", 1)
+        if b % shards:
+            raise ValueError(
+                f"batch of {b} cannot shard evenly over {shards} 'data' "
+                "chips; the dispatcher pads buckets to a multiple of the "
+                "mesh size before staging"
+            )
+    return jax.device_put(
+        (y, cb, cr, qy, qc, depths, intrinsics, depth_scales), device
+    )
+
+
+def _assemble_plane(samples, blocks_h: int, blocks_w: int):
+    """[B, blocks_h*blocks_w, 64] block samples -> [B, 8*bh, 8*bw]."""
+    b = samples.shape[0]
+    x = samples.reshape(b, blocks_h, blocks_w, 8, 8)
+    return x.transpose(0, 1, 3, 2, 4).reshape(
+        b, blocks_h * 8, blocks_w * 8
+    )
+
+
+def _upsample_h2v2(plane):
+    """libjpeg ``h2v2_fancy_upsample``, exact integer arithmetic.
+
+    [B, ch, cw] int32 -> [B, 2ch, 2cw]: vertical 3:1 column sums with
+    edge-clamped neighbors, then the 9/16-3/16-3/16-1/16 horizontal
+    triangle with libjpeg's alternating +8/+7 rounding biases. Interleaves
+    are stack+reshape (no scatters).
+    """
+    b, ih, iw = plane.shape
+    above = np.clip(np.arange(ih) - 1, 0, ih - 1)
+    below = np.clip(np.arange(ih) + 1, 0, ih - 1)
+    even = 3 * plane + plane[:, above]
+    odd = 3 * plane + plane[:, below]
+    colsum = jnp.stack([even, odd], axis=2).reshape(b, 2 * ih, iw)
+    left = np.clip(np.arange(iw) - 1, 0, iw - 1)
+    right = np.clip(np.arange(iw) + 1, 0, iw - 1)
+    h_even = (3 * colsum + colsum[:, :, left] + 8) >> 4
+    h_odd = (3 * colsum + colsum[:, :, right] + 7) >> 4
+    return jnp.stack([h_even, h_odd], axis=3).reshape(b, 2 * ih, 2 * iw)
+
+
+def _upsample_h2v1(plane):
+    """libjpeg ``h2v1_fancy_upsample``: horizontal-only triangle."""
+    b, ih, iw = plane.shape
+    left = np.clip(np.arange(iw) - 1, 0, iw - 1)
+    right = np.clip(np.arange(iw) + 1, 0, iw - 1)
+    h_even = (3 * plane + plane[:, :, left] + 1) >> 2
+    h_odd = (3 * plane + plane[:, :, right] + 2) >> 2
+    return jnp.stack([h_even, h_odd], axis=3).reshape(b, ih, 2 * iw)
+
+
+def _ycc_to_rgb(y, cb, cr):
+    """libjpeg ``ycc_rgb_convert``: SCALEBITS=16 fixed point, exact.
+
+    int32 planes (0..255) -> uint8 [B, H, W, 3]. Arithmetic right shifts
+    on int32 match the C tables bit for bit.
+    """
+    cb = cb - 128
+    cr = cr - 128
+    r = y + ((_ycc_fix(1.40200) * cr + _YCC_HALF) >> _YCC_SCALE)
+    b = y + ((_ycc_fix(1.77200) * cb + _YCC_HALF) >> _YCC_SCALE)
+    g = y + (
+        (-_ycc_fix(0.34414) * cb - _ycc_fix(0.71414) * cr + _YCC_HALF)
+        >> _YCC_SCALE
+    )
+    rgb = jnp.stack([r, g, b], axis=-1)
+    return jnp.clip(rgb, 0, 255).astype(jnp.uint8)
+
+
+def decode_coef_batch(y, cb, cr, qy, qc, *, height: int, width: int,
+                      subsampling: str, impl: str = "auto"):
+    """The on-chip half of the split JPEG decode, batched.
+
+    Args:
+        y/cb/cr: [B, N, 64] int16 quantized coefficient planes (natural
+            order, block raster -- ``serving.entropy.CoefficientFrame``).
+        qy/qc: [B, 64] uint16 quant tables (per frame).
+        height/width/subsampling: static frame geometry.
+        impl: kernel dispatch for the dequant+IDCT stage
+            (``GeometryConfig.kernel_impl`` semantics).
+
+    Returns uint8 RGB [B, height, width, 3], bitwise equal to what
+    libjpeg/cv2.imdecode produces from the same coefficients.
+    """
+    from robotic_discovery_platform_tpu.ops.pallas import (
+        decode as pallas_decode,
+    )
+    from robotic_discovery_platform_tpu.serving.entropy import block_grids
+
+    (ybh, ybw), (cbh, cbw) = block_grids(height, width, subsampling)
+    y_pix = _assemble_plane(
+        pallas_decode.dequant_idct(y, qy, impl=impl), ybh, ybw
+    )[:, :height, :width]
+    cb_pix = _assemble_plane(
+        pallas_decode.dequant_idct(cb, qc, impl=impl), cbh, cbw
+    )
+    cr_pix = _assemble_plane(
+        pallas_decode.dequant_idct(cr, qc, impl=impl), cbh, cbw
+    )
+    # Crop the chroma planes to their TRUE downsampled dims before
+    # upsampling: the block grid pads to whole MCUs, and the fancy
+    # upsamplers' edge-clamped neighbor taps must replicate the real
+    # last row/column (libjpeg's edge rule), not read MCU padding.
+    if subsampling == "420":
+        ch, cw = (height + 1) // 2, (width + 1) // 2
+        cb_pix = _upsample_h2v2(cb_pix[:, :ch, :cw])
+        cr_pix = _upsample_h2v2(cr_pix[:, :ch, :cw])
+    elif subsampling == "422":
+        ch, cw = height, (width + 1) // 2
+        cb_pix = _upsample_h2v1(cb_pix[:, :ch, :cw])
+        cr_pix = _upsample_h2v1(cr_pix[:, :ch, :cw])
+    cb_pix = cb_pix[:, :height, :width]
+    cr_pix = cr_pix[:, :height, :width]
+    return _ycc_to_rgb(y_pix, cb_pix, cr_pix)
+
+
+def make_coef_batch_analyzer(
+    model,
+    img_size: int = 256,
+    geom_cfg: GeometryConfig = GeometryConfig(),
+    threshold: float = 0.5,
+    forward=None,
+    *,
+    height: int,
+    width: int,
+    subsampling: str = "420",
+):
+    """Batched analyzer whose wire-side input is coefficient planes.
+
+    The decode stage (:func:`decode_coef_batch`) is slotted AHEAD of the
+    fused analyzer inside ONE jit graph: coefficients arrive via
+    :func:`stage_coef_batch`, the decoded RGB lives only in device memory,
+    and the analyzer consumes it directly -- the host never sees pixels.
+    Frame geometry is static per analyzer (the dispatcher already groups
+    by (model, frame shape), and coef groups add subsampling to the key).
+
+    Call shape: ``analyze(variables, y, cb, cr, qy, qc, depths,
+    intrinsics, depth_scales) -> FrameAnalysis``.
+    """
+
+    @jax.jit
+    @recompile.trace_guard("pipeline.coef_batch_analyzer", budget=8)
+    @shape_contract(y="b n 64", cb="b m 64", cr="b m 64", qy="b 64",
+                    qc="b 64", depths="b h w", intrinsics="b 3 3",
+                    depth_scales="b")
+    def analyze(variables, y, cb, cr, qy, qc, depths, intrinsics,
+                depth_scales):
+        frames_rgb = decode_coef_batch(
+            y, cb, cr, qy, qc, height=height, width=width,
+            subsampling=subsampling, impl=geom_cfg.kernel_impl,
+        )
+        return _analyze_batch(
+            model, variables, frames_rgb, depths,
+            jnp.asarray(intrinsics, jnp.float32),
+            jnp.asarray(depth_scales, jnp.float32),
+            img_size, geom_cfg, threshold, forward,
+        )
+
+    return transferguard.apply(analyze)
+
+
 def make_scan_batch_analyzer(
     model,
     img_size: int = 256,

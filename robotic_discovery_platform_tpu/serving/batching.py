@@ -160,6 +160,7 @@ from robotic_discovery_platform_tpu.resilience import (
 from robotic_discovery_platform_tpu.resilience import (
     sites as fault_sites,
 )
+from robotic_discovery_platform_tpu.serving import entropy
 from robotic_discovery_platform_tpu.serving.admission import (
     DeadlineQueue,
     OverloadedError,
@@ -469,7 +470,10 @@ class DeviceRouter:
 
 @dataclass(eq=False)  # identity semantics: instances live in _pending sets
 class _Pending:
-    frame_rgb: np.ndarray
+    #: pixels -- or the coefficient half of a split decode, in which case
+    #: this frame rides the dispatcher's coefficient lane (grouped by
+    #: (model, "coef", geometry, subsampling); the device decodes)
+    frame_rgb: np.ndarray | entropy.CoefficientFrame
     depth: np.ndarray
     intrinsics: np.ndarray
     depth_scale: float
@@ -501,6 +505,29 @@ class _Pending:
     failovers: int = 0
 
 
+#: host staging alignment (bytes). 64 covers a cache line and the widest
+#: vector loads the runtime's H2D memcpy uses; np.empty only guarantees
+#: 16, so pooled buffers over-allocate and slice to a 64-byte boundary.
+_STAGE_ALIGN = 64
+
+
+def _aligned_empty(shape: tuple, dtype) -> np.ndarray:
+    """``np.empty`` whose first byte sits on a ``_STAGE_ALIGN`` boundary.
+
+    Over-allocates by one alignment unit and views in at the aligned
+    offset -- the portable way to pin staging-buffer alignment without a
+    real pinned-memory API. The base allocation stays referenced through
+    the view, and pooled retention (``_pool_take``/``_pool_put``) is what
+    keeps the pages resident: each (geometry, bucket) key settles on a
+    few long-lived buffer sets that every H2D transfer reads from, so
+    the runtime's staging copies always start cache-line-aligned."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape)) * dtype.itemsize
+    raw = np.empty(nbytes + _STAGE_ALIGN, np.uint8)
+    offset = (-raw.ctypes.data) % _STAGE_ALIGN
+    return raw[offset:offset + nbytes].view(dtype).reshape(shape)
+
+
 class _BucketBuffers:
     """One reusable set of host staging arrays for a (geometry, bucket)
     key: the collector fills rows in place instead of building fresh
@@ -508,6 +535,10 @@ class _BucketBuffers:
     in-flight dispatch (the completer returns it to the pool only after
     the dispatch's device work is done), so refilling can never race a
     zero-copy ``device_put`` of a still-executing batch.
+
+    Every array is allocated 64-byte-aligned (:func:`_aligned_empty`) and
+    pinned by pool retention, so the runtime's H2D staging copy always
+    streams from an aligned, resident host buffer.
 
     Fill-in-place contract (:meth:`fill` / :meth:`pad`): a frame's row is
     written straight from the pending frame's arrays into the slot this
@@ -523,10 +554,10 @@ class _BucketBuffers:
     def __init__(self, key: tuple, template: _Pending, b: int):
         h, w = template.frame_rgb.shape[:2]
         self.key = key
-        self.frames = np.empty((b, h, w, 3), template.frame_rgb.dtype)
-        self.depths = np.empty((b, h, w), template.depth.dtype)
-        self.intr = np.empty((b, 3, 3), np.float32)
-        self.scales = np.empty((b,), np.float32)
+        self.frames = _aligned_empty((b, h, w, 3), template.frame_rgb.dtype)
+        self.depths = _aligned_empty((b, h, w), template.depth.dtype)
+        self.intr = _aligned_empty((b, 3, 3), np.float32)
+        self.scales = _aligned_empty((b,), np.float32)
 
     def fill(self, i: int, p: _Pending) -> None:
         """Write frame ``p`` into row ``i`` in place (the ONE host copy a
@@ -546,13 +577,65 @@ class _BucketBuffers:
             self.scales[n:] = self.scales[0]
 
 
+class _CoefBucketBuffers:
+    """The coefficient-lane counterpart of :class:`_BucketBuffers`: pooled,
+    64-byte-aligned host staging for frames whose color half is an
+    entropy-decoded :class:`~serving.entropy.CoefficientFrame` (wire
+    ``format = 2``, or the on-chip reference decode). The staged payload
+    is the three quantized int16 coefficient planes plus the per-frame
+    quant tables -- ``ops/pipeline.stage_coef_batch`` device_puts these
+    buffers directly and the pixels first exist on the device."""
+
+    __slots__ = ("key", "y", "cb", "cr", "qy", "qc",
+                 "depths", "intr", "scales")
+
+    def __init__(self, key: tuple, template: _Pending, b: int):
+        cf = template.frame_rgb
+        (ybh, ybw), (cbh, cbw) = entropy.block_grids(
+            cf.height, cf.width, cf.subsampling
+        )
+        ny, nc = ybh * ybw, cbh * cbw
+        dh, dw = template.depth.shape
+        self.key = key
+        self.y = _aligned_empty((b, ny, 64), np.int16)
+        self.cb = _aligned_empty((b, nc, 64), np.int16)
+        self.cr = _aligned_empty((b, nc, 64), np.int16)
+        self.qy = _aligned_empty((b, 64), np.uint16)
+        self.qc = _aligned_empty((b, 64), np.uint16)
+        self.depths = _aligned_empty((b, dh, dw), template.depth.dtype)
+        self.intr = _aligned_empty((b, 3, 3), np.float32)
+        self.scales = _aligned_empty((b,), np.float32)
+
+    def fill(self, i: int, p: _Pending) -> None:
+        cf = p.frame_rgb
+        self.y[i] = cf.y
+        self.cb[i] = cf.cb
+        self.cr[i] = cf.cr
+        self.qy[i] = cf.qy
+        self.qc[i] = cf.qc
+        self.depths[i] = p.depth
+        self.intr[i] = p.intrinsics
+        self.scales[i] = p.depth_scale
+
+    def pad(self, n: int) -> None:
+        if n < len(self.y):
+            self.y[n:] = self.y[0]
+            self.cb[n:] = self.cb[0]
+            self.cr[n:] = self.cr[0]
+            self.qy[n:] = self.qy[0]
+            self.qc[n:] = self.qc[0]
+            self.depths[n:] = self.depths[0]
+            self.intr[n:] = self.intr[0]
+            self.scales[n:] = self.scales[0]
+
+
 @dataclass(eq=False)
 class _Dispatch:
     """A launched-but-not-completed batch riding the completion queue."""
 
     group: list[_Pending]
     out: Any  # the analyzer's (possibly still-computing) output tree
-    bufs: _BucketBuffers | None
+    bufs: _BucketBuffers | _CoefBucketBuffers | None
     # the in-flight slot this dispatch holds; released by the completer.
     # Carried per-dispatch so a watchdog window reset can never double-free
     # a fresh semaphore.
@@ -596,6 +679,17 @@ def _bucket(n: int, max_batch: int) -> int:
     while b < n:
         b *= 2
     return min(b, max_batch)
+
+
+def _group_key(p: _Pending) -> tuple:
+    """The collector's dispatch-group key: frames only ever batch with
+    same-model, same-geometry co-arrivals -- and coefficient-lane frames
+    additionally split by subsampling (the decode graph's shapes depend
+    on it), never mixing with pixel frames."""
+    f = p.frame_rgb
+    if isinstance(f, entropy.CoefficientFrame):
+        return (p.model, "coef", f.subsampling, f.height, f.width)
+    return (p.model, f.shape[:2])
 
 
 @dataclass(eq=False)
@@ -656,6 +750,13 @@ class BatchDispatcher:
         model_label: display name of the DEFAULT model ("" key) in fault
             sites / metrics / placer keys -- the zoo's default entry
             name ("seg"); "default" when unset.
+        coef_analyzer_factory: optional ``(model, height, width,
+            subsampling) -> Callable`` building the batched decode+analyze
+            graph for coefficient-lane frames
+            (ops/pipeline.make_coef_batch_analyzer closed over the
+            model's variables). Lazily invoked + memoized per key on the
+            first coef dispatch of that geometry; None (default) rejects
+            ``submit_coef`` dispatches.
         clock: injectable monotonic clock for every deadline decision
             (submit deadline, unmeetable-deadline shed, coalescing
             window) and the admission queue's headroom ordering -- one
@@ -673,8 +774,17 @@ class BatchDispatcher:
                  admission: str = "deadline",
                  flight_recorder: recorder_lib.FlightRecorder | None = None,
                  placer=None, model_label: str = "default",
+                 coef_analyzer_factory: Callable | None = None,
                  clock: Callable[[], float] = time.monotonic):
         self._analyze = analyze_batch
+        # coefficient lane (split JPEG decode): ``(model, height, width,
+        # subsampling) -> batched decode+analyze callable`` (the serving
+        # engine wires ops/pipeline.make_coef_batch_analyzer). Analyzers
+        # are memoized per key on first dispatch; None fails coef
+        # submissions with a clear error instead of a shape mismatch.
+        self._coef_factory = coef_analyzer_factory  # guarded_by: _coef_lock
+        self._coef_analyzers: dict[tuple, Callable] = {}  # guarded_by: _coef_lock
+        self._coef_lock = checked_lock("batching.coef")
         # one time source for every CONTROL decision (submit deadlines,
         # unmeetable-deadline sheds, the coalescing window) AND the
         # admission queue's headroom ordering. The queue always took an
@@ -841,6 +951,14 @@ class BatchDispatcher:
         """Every model key this dispatcher routes ("" = default)."""
         return ("", *self._bindings)
 
+    def set_coef_analyzer_factory(self, factory: Callable | None) -> None:
+        """(Re)bind the coefficient-lane analyzer factory and drop the
+        memoized graphs -- hot reload swaps model variables, so stale
+        closures must not outlive the generation that built them."""
+        with self._coef_lock:
+            self._coef_factory = factory
+            self._coef_analyzers.clear()
+
     def _display_model(self, model: str) -> str:
         return model or self._model_label
 
@@ -861,6 +979,36 @@ class BatchDispatcher:
         misses the submit deadline (``timeout_s`` if given and tighter,
         else ``submit_timeout_s``).
         """
+        return self._submit_frame(frame_rgb, depth, intrinsics,
+                                  depth_scale, timeout_s, model)
+
+    def submit_coef(self, frame: entropy.CoefficientFrame, depth,
+                    intrinsics, depth_scale,
+                    timeout_s: float | None = None, model: str = ""):
+        """Coefficient-lane :meth:`submit`: the color half is an
+        entropy-decoded :class:`~serving.entropy.CoefficientFrame`
+        (``format = 2`` wire payloads or the on-chip reference decode)
+        and the pixels first exist on the device, decoded fused ahead of
+        the analyzer. Batching, admission, deadlines, routing, and the
+        result contract are identical to :meth:`submit`; frames group by
+        (model, geometry, subsampling) and never mix with pixel
+        frames."""
+        if not isinstance(frame, entropy.CoefficientFrame):
+            raise TypeError(
+                f"submit_coef wants a CoefficientFrame, got "
+                f"{type(frame).__name__}; pixel arrays ride submit()"
+            )
+        depth = np.asarray(depth)
+        if depth.shape != (frame.height, frame.width):
+            raise ValueError(
+                f"depth shape {depth.shape} != frame geometry "
+                f"({frame.height}, {frame.width})"
+            )
+        return self._submit_frame(frame, depth, intrinsics, depth_scale,
+                                  timeout_s, model)
+
+    def _submit_frame(self, frame_rgb, depth, intrinsics, depth_scale,
+                      timeout_s: float | None, model: str):
         if model and model not in self._bindings:
             raise ValueError(
                 f"unknown model {model!r}; bound: {self.bound_models()}"
@@ -1188,13 +1336,11 @@ class BatchDispatcher:
             # its own frames (per-model fault isolation)
             by_key: dict[tuple, list[_Pending]] = {}
             for p in batch:
-                by_key.setdefault(
-                    (p.model, p.frame_rgb.shape[:2]), []
-                ).append(p)
+                by_key.setdefault(_group_key(p), []).append(p)
             for group in by_key.values():
                 self._launch_group(group, collected_ns)
 
-    def _pool_take(self, key: tuple, template: _Pending) -> _BucketBuffers:
+    def _pool_take(self, key: tuple, template: _Pending):
         with self._pool_lock:
             free = self._pool.get(key)
             if free:
@@ -1203,7 +1349,10 @@ class BatchDispatcher:
                     sum(len(v) for v in self._pool.values())
                 )
                 return bufs
-        return _BucketBuffers(key, template, key[0])
+        cls = (_CoefBucketBuffers
+               if isinstance(template.frame_rgb, entropy.CoefficientFrame)
+               else _BucketBuffers)
+        return cls(key, template, key[0])
 
     def _pool_put(self, bufs: _BucketBuffers | None) -> None:
         if bufs is None:
@@ -1363,6 +1512,47 @@ class BatchDispatcher:
             with self._warm_lock:
                 self.warmed.add((model, key, b))
 
+    def warm_coef(self, frame, depths, intrinsics, scales,
+                  model: str = "", chips=None) -> None:
+        """Coefficient-lane counterpart of :meth:`warm`: compile + run the
+        fused decode+analyze graph for ``frame``'s (geometry, subsampling)
+        at batch ``len(depths)`` on every routed placement (or an explicit
+        chip list), so a coefficient-wire burst's first dispatch never pays
+        XLA compilation inside a frame deadline.
+
+        ``frame`` is a single :class:`entropy.CoefficientFrame`; its planes
+        are replicated across the batch rows (pixel content is irrelevant
+        to compilation -- only shapes, dtypes, and the subsampling layout
+        key the jit cache). The decode+analyze closure itself comes from
+        the same ``_coef_analyze_for`` memo live dispatches use, so the
+        warmed compilation is exactly the one a live frame would hit."""
+        r = self._router
+        b = len(depths)
+        probe = _Pending(frame, np.asarray(depths[0]),
+                         np.asarray(intrinsics[0], np.float32),
+                         float(scales[0]), model=model)
+        analyze = self._coef_analyze_for(probe, model)
+
+        def _rep(a):
+            return np.repeat(np.asarray(a)[None], b, axis=0)
+
+        arrays = (_rep(frame.y), _rep(frame.cb), _rep(frame.cr),
+                  _rep(frame.qy), _rep(frame.qc),
+                  np.asarray(depths),
+                  np.asarray(intrinsics, np.float32),
+                  np.asarray(scales, np.float32))
+        if r is not None and r.mode == "sharded":
+            placements: list[tuple[Any, Any]] = [(r.sharding, None)]
+        else:
+            placements = [(self._placement(chip), chip)
+                          for chip in (range(self._n_windows)
+                                       if chips is None else chips)]
+        for device, key in placements:
+            staged = pipeline_lib.stage_coef_batch(*arrays, device=device)
+            jax.block_until_ready(analyze(*staged))
+            with self._warm_lock:
+                self.warmed.add((model, key, ("coef", b)))
+
     def _stage_group(self, group: list[_Pending], b: int):
         """Host-side staging: the padded [b, ...] batch arrays for a group.
 
@@ -1386,6 +1576,56 @@ class BatchDispatcher:
             bufs.fill(i, p)
         bufs.pad(n)
         return bufs, bufs.frames, bufs.depths, bufs.intr, bufs.scales
+
+    def _stage_coef_group(self, group: list[_Pending], b: int):
+        """Coefficient-lane staging: the padded batch of quantized
+        coefficient planes + quant tables + depth/geometry for one group.
+
+        Returns ``(bufs, arrays)`` where ``arrays`` is the 8-tuple
+        ``ops/pipeline.stage_coef_batch`` stages. The b == 1 fast path
+        device_puts ``[None]`` views of the unpacked wire payload itself
+        (for ``format = 2`` those are ``np.frombuffer`` views of the gRPC
+        message buffer -- the wire bytes ARE the H2D source); b > 1 rides
+        pooled 64-byte-aligned buffers like the pixel lane."""
+        n = len(group)
+        first = group[0]
+        cf = first.frame_rgb
+        if b == 1:
+            return (None, (cf.y[None], cf.cb[None], cf.cr[None],
+                           cf.qy[None], cf.qc[None], first.depth[None],
+                           first.intrinsics[None],
+                           np.asarray([first.depth_scale], np.float32)))
+        key = (b, "coef", cf.subsampling, cf.height, cf.width,
+               first.depth.shape, first.depth.dtype.str)
+        bufs = self._pool_take(key, first)
+        for i, p in enumerate(group):
+            bufs.fill(i, p)
+        bufs.pad(n)
+        return bufs, (bufs.y, bufs.cb, bufs.cr, bufs.qy, bufs.qc,
+                      bufs.depths, bufs.intr, bufs.scales)
+
+    def _coef_analyze_for(self, p: _Pending, model: str) -> Callable:
+        """The memoized decode+analyze graph for a coefficient-lane
+        frame's (model, geometry, subsampling). Built lazily through the
+        serving layer's ``coef_analyzer_factory`` on first dispatch (the
+        capped-warmup contract: eagerly compiling every combination
+        would explode startup)."""
+        cf = p.frame_rgb
+        key = (model, cf.height, cf.width, cf.subsampling)
+        with self._coef_lock:
+            factory = self._coef_factory
+            analyze = self._coef_analyzers.get(key)
+        if factory is None:
+            raise ValueError(
+                "coefficient-lane frame dispatched but no "
+                "coef_analyzer_factory is bound (the serving engine "
+                "wires ops/pipeline.make_coef_batch_analyzer here)"
+            )
+        if analyze is None:
+            analyze = factory(model, cf.height, cf.width, cf.subsampling)
+            with self._coef_lock:
+                analyze = self._coef_analyzers.setdefault(key, analyze)
+        return analyze
 
     def _launch_group(self, group: list[_Pending],
                       collected_ns: int | None = None) -> None:
@@ -1454,19 +1694,33 @@ class BatchDispatcher:
             for p in group:
                 obs.HOST_STAGE_SPLIT.labels(stage="admit").observe(
                     max(0, collected_ns - p.submit_ns) / 1e9)
+            coef = isinstance(group[0].frame_rgb, entropy.CoefficientFrame)
             t0 = time.monotonic_ns()
-            bufs, frames, depths, intr, scales = self._stage_group(group, b)
-            t_fill = time.monotonic_ns()
-            staged = pipeline_lib.stage_batch(
-                frames, depths, intr, scales, device=self._placement(chip)
-            )
+            if coef:
+                bufs, arrays = self._stage_coef_group(group, b)
+                t_fill = time.monotonic_ns()
+                staged = pipeline_lib.stage_coef_batch(
+                    *arrays, device=self._placement(chip)
+                )
+                analyze = self._coef_analyze_for(group[0], model)
+            else:
+                bufs, frames, depths, intr, scales = self._stage_group(
+                    group, b
+                )
+                t_fill = time.monotonic_ns()
+                staged = pipeline_lib.stage_batch(
+                    frames, depths, intr, scales,
+                    device=self._placement(chip)
+                )
+                analyze = self._analyze_for(chip, model)
             t1 = time.monotonic_ns()
             # jit async dispatch: returns once the computation is enqueued
             # (an unwarmed (model, chip, bucket) pays its XLA compile
             # here -- the capped-warmup contract: lazy by default)
-            out = self._analyze_for(chip, model)(*staged)
+            out = analyze(*staged)
             t2 = time.monotonic_ns()
-            warm_key = (model, None if mode == "sharded" else chip, b)
+            warm_key = (model, None if mode == "sharded" else chip,
+                        ("coef", b) if coef else b)
             with self._warm_lock:
                 self.warmed.add(warm_key)
             tl.span("stage", start_ns=t0, end_ns=t1, parent=root)

@@ -63,12 +63,44 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
     server maps as zero-copy views and never runs through ``imdecode``
     (serving/ingest.py) -- more ingress bytes, near-zero server decode.
 
+    ``fmt="coef"`` is the split-decode wire: the client JPEG-encodes the
+    color frame once, entropy-decodes it at the edge
+    (serving/entropy.py), and ships the quantized coefficient blocks as
+    ``Image.format = 2``. The server's whole host-side color decode is
+    then ``np.frombuffer`` views, and dequant + IDCT + chroma upsample +
+    color convert run fused ahead of the analyzer on the accelerator --
+    the decoded image never exists on the server's host. Wire size sits
+    between JPEG and raw (coefficients are sparse but uncompressed);
+    depth rides raw z16. The decoded pixels are bitwise identical to the
+    server decoding the same JPEG with ``cv2.imdecode``.
+
     ``model`` selects the model-zoo entry by name (serving/zoo.py);
     "" (default) is the server's default model, and serializes to ZERO
     extra wire bytes -- a legacy request is bitwise identical."""
     import cv2
 
     h, w = color_bgr.shape[:2]
+    if fmt == "coef":
+        from robotic_discovery_platform_tpu.serving import entropy, ingest
+
+        ok_c, jpg = cv2.imencode(".jpg", color_bgr)
+        if not ok_c:
+            raise ValueError("frame encode failed")
+        payload = entropy.pack_coefficients(
+            entropy.parse_jpeg(jpg.tobytes())
+        )
+        z16 = np.ascontiguousarray(depth, dtype="<u2")
+        return vision_pb2.AnalysisRequest(
+            color_image=vision_pb2.Image(
+                data=payload, width=w, height=h,
+                format=ingest.FORMAT_COEF,
+            ),
+            depth_image=vision_pb2.Image(
+                data=z16.tobytes(), width=w, height=h,
+                format=ingest.FORMAT_RAW,
+            ),
+            model=model,
+        )
     if fmt == "raw":
         from robotic_discovery_platform_tpu.serving import ingest
 
@@ -87,7 +119,7 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
         )
     if fmt != "encoded":
         raise ValueError(f"unknown request format {fmt!r}; "
-                         "expected 'encoded' or 'raw'")
+                         "expected 'encoded', 'raw', or 'coef'")
     ok_c, jpg = cv2.imencode(".jpg", color_bgr)
     ok_d, png = cv2.imencode(".png", depth)
     if not (ok_c and ok_d):

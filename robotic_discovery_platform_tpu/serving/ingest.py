@@ -48,11 +48,14 @@ error-completes that frame only; the worker keeps draining) and
 the worker thread itself -- the watchdog-restart drill).
 
 Observability: ``rdp_decode_seconds{format}`` (actual decode work,
-wherever it ran), ``rdp_decode_queue_depth``,
+wherever it ran; ``format="coef"`` is the split-decode wire),
+``rdp_decode_queue_depth``,
 ``rdp_host_stage_split_seconds{stage="decode"}`` (the host-path split
-``bench_load.py --host-profile`` reads), and one flight-recorder
-``ingest`` timeline per decoded frame whose ``decode`` span joins the
-dispatch timelines at ``GET /debug/spans``.
+``bench_load.py --host-profile`` reads; split-decode frames additionally
+report their host half under ``stage="entropy"``), and one
+flight-recorder ``ingest`` timeline per decoded frame whose ``decode``
+(or ``entropy``) span joins the dispatch timelines at
+``GET /debug/spans``.
 
 Everything here is host-side; with ``decode_workers=0`` the serial
 depth-1 serving path stays bitwise-identical to the pre-ingest server.
@@ -80,6 +83,7 @@ from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
 from robotic_discovery_platform_tpu.resilience import (
     sites as fault_sites,
 )
+from robotic_discovery_platform_tpu.serving import entropy
 from robotic_discovery_platform_tpu.serving.proto import vision_pb2
 from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
 from robotic_discovery_platform_tpu.utils.logging import get_logger
@@ -87,12 +91,17 @@ from robotic_discovery_platform_tpu.utils.logging import get_logger
 log = get_logger(__name__)
 
 _WORKERS_ENV_VAR = "RDP_DECODE_WORKERS"
+_ONCHIP_ENV_VAR = "RDP_ONCHIP_DECODE"
 
 #: ``Image.format`` wire values (protos/vision.proto). The proto3 default
 #: of 0 is the historical encoded behavior, so the field is
-#: wire-compatible with pre-format clients.
+#: wire-compatible with pre-format clients. ``format = 2`` carries
+#: entropy-decoded JPEG coefficient blocks (serving/entropy.py wire
+#: layout): the host's whole decode is np.frombuffer views, and the
+#: dequant+IDCT+upsample+color-convert ride the device graph.
 FORMAT_ENCODED = 0
 FORMAT_RAW = 1
+FORMAT_COEF = 2
 
 
 #: anything above this is "no deadline": grpc reports deadline-less
@@ -107,6 +116,20 @@ def normalize_remaining(remaining: float | None) -> float | None:
     if remaining is None or remaining > _NO_DEADLINE_S:
         return None
     return remaining
+
+
+def resolve_onchip_decode(configured: bool) -> bool:
+    """The effective on-chip decode mode: ``RDP_ONCHIP_DECODE`` when set
+    ("1"/"true"/"strict" enable, anything else disables), else
+    ``ServerConfig.onchip_decode``. When on, baseline-JPEG color payloads
+    are entropy-decoded on the host (serving/entropy.py, the reference
+    implementation -- pure Python, so slower than cv2; the production
+    path is clients shipping ``format = 2`` directly) and the pixel
+    half of the decode runs on the device next to the analyzer."""
+    raw = os.environ.get(_ONCHIP_ENV_VAR)
+    if raw is None:
+        return bool(configured)
+    return raw.strip().lower() in ("1", "true", "yes", "on", "strict")
 
 
 def resolve_decode_workers(configured: int) -> int:
@@ -128,15 +151,34 @@ def default_intrinsics(w: int, h: int) -> np.ndarray:
     return np.array([[f, 0, w / 2], [0, f, h / 2], [0, 0, 1]], np.float64)
 
 
-def decode_color(img: vision_pb2.Image) -> np.ndarray:
-    """One color payload -> [H, W, 3] uint8 RGB.
+def decode_color(
+    img: vision_pb2.Image, *, onchip: bool = False
+) -> np.ndarray | entropy.CoefficientFrame:
+    """One color payload -> [H, W, 3] uint8 RGB, or the coefficient half
+    of a split decode (:class:`~serving.entropy.CoefficientFrame`) when
+    the pixels are destined for the device decoder.
 
     Raw payloads map the wire bytes directly (``np.frombuffer`` view --
     zero-copy, read-only; the analyzer and the staging buffers never
-    write into frames). Encoded payloads pay ``cv2.imdecode`` plus ONE
+    write into frames). ``format = 2`` coefficient payloads are likewise
+    pure views (serving/entropy.py wire layout) -- the host never touches
+    a pixel. Encoded payloads pay ``cv2.imdecode`` plus ONE
     ``cv2.cvtColor`` BGR->RGB pass -- a channel permutation, so bitwise
     identical to the historical ``np.ascontiguousarray(bgr[..., ::-1])``
-    at a fraction of its cost."""
+    at a fraction of its cost -- unless ``onchip`` is set, in which case
+    baseline JPEGs are entropy-decoded on the host (the pure-Python
+    reference split; unsupported variants fall back to cv2, corrupt
+    streams raise)."""
+    if img.format == FORMAT_COEF:
+        frame = entropy.unpack_coefficients(img.data)
+        if img.width and img.height and (
+            frame.height != img.height or frame.width != img.width
+        ):
+            raise ValueError(
+                f"coefficient payload is {frame.width}x{frame.height}; "
+                f"Image says {img.width}x{img.height}"
+            )
+        return frame
     if img.format == FORMAT_RAW:
         expect = img.height * img.width * 3
         if len(img.data) != expect:
@@ -147,6 +189,15 @@ def decode_color(img: vision_pb2.Image) -> np.ndarray:
         return np.frombuffer(img.data, np.uint8).reshape(
             img.height, img.width, 3
         )
+    if onchip and img.data[:2] == b"\xff\xd8":
+        try:
+            return entropy.parse_jpeg(img.data)
+        except ValueError as exc:
+            # exotic-but-valid content (progressive, CMYK, 12-bit...)
+            # stays on the cv2 path; corrupt/truncated streams are real
+            # frame errors and propagate
+            if not str(exc).startswith("unsupported"):
+                raise
     import cv2
 
     bgr = cv2.imdecode(np.frombuffer(img.data, np.uint8), cv2.IMREAD_COLOR)
@@ -179,8 +230,11 @@ def decode_depth(img: vision_pb2.Image) -> np.ndarray:
 
 
 def request_format(request: vision_pb2.AnalysisRequest) -> str:
-    """Label for the request's payload encoding: 'raw' (both images raw),
-    'encoded' (both encoded), or 'mixed'."""
+    """Label for the request's payload encoding: 'coef' (color carries
+    coefficient blocks for the device decoder; depth rides raw), 'raw'
+    (both images raw), 'encoded' (both encoded), or 'mixed'."""
+    if request.color_image.format == FORMAT_COEF:
+        return "coef"
     c = request.color_image.format == FORMAT_RAW
     d = request.depth_image.format == FORMAT_RAW
     if c and d:
@@ -191,13 +245,13 @@ def request_format(request: vision_pb2.AnalysisRequest) -> str:
 
 
 def decode_request(
-    request: vision_pb2.AnalysisRequest,
-) -> tuple[np.ndarray, np.ndarray, str]:
-    """``AnalysisRequest`` -> ``(rgb [H,W,3] u8, depth [H,W] u16, fmt)``.
-    The per-frame decode core; callers wanting metrics/fault-injection
-    ride :meth:`DecodePool.decode` instead."""
+    request: vision_pb2.AnalysisRequest, *, onchip: bool = False
+) -> tuple[np.ndarray | entropy.CoefficientFrame, np.ndarray, str]:
+    """``AnalysisRequest`` -> ``(rgb-or-coefficients, depth [H,W] u16,
+    fmt)``. The per-frame decode core; callers wanting metrics and
+    fault-injection ride :meth:`DecodePool.decode` instead."""
     fmt = request_format(request)
-    return (decode_color(request.color_image),
+    return (decode_color(request.color_image, onchip=onchip),
             decode_depth(request.depth_image), fmt)
 
 
@@ -293,7 +347,7 @@ class _PendingDecode:
     #: frame sheds it BEFORE decoding (admission extended to pre-decode)
     deadline_t: float | None = None
     done: threading.Event = field(default_factory=threading.Event)
-    rgb: np.ndarray | None = None
+    rgb: np.ndarray | entropy.CoefficientFrame | None = None
     depth: np.ndarray | None = None
     fmt: str = "encoded"
     error: BaseException | None = None
@@ -305,9 +359,12 @@ class _PendingDecode:
 @dataclass
 class IngestFrame:
     """What the stream handler consumes: one ready-to-stage frame (or its
-    terminal error), plus the timing the serving metrics want."""
+    terminal error), plus the timing the serving metrics want. ``rgb``
+    holds pixels -- or a :class:`~serving.entropy.CoefficientFrame` when
+    the split decode finishes on the device (``fmt == "coef"`` wire
+    payloads, or the on-chip reference mode)."""
 
-    rgb: np.ndarray | None
+    rgb: np.ndarray | entropy.CoefficientFrame | None
     depth: np.ndarray | None
     error: BaseException | None
     #: caller deadline budget observed when the request was read (the
@@ -334,11 +391,14 @@ class DecodePool:
     """
 
     def __init__(self, workers: int, *, watchdog_interval_s: float = 1.0,
-                 prefetch: int = 2,
+                 prefetch: int = 2, onchip: bool = False,
                  flight_recorder: recorder_lib.FlightRecorder | None = None,
                  clock: Callable[[], float] = time.monotonic):
         self.workers = max(0, int(workers))
         self.prefetch = max(1, int(prefetch))
+        #: host-side entropy decode of baseline JPEG (the split-decode
+        #: reference mode; see resolve_onchip_decode)
+        self.onchip = bool(onchip)
         self._clock = clock
         self._recorder = (flight_recorder if flight_recorder is not None
                           else recorder_lib.RECORDER)
@@ -377,17 +437,24 @@ class DecodePool:
         recorder timeline whose ``decode`` span joins ``/debug/spans``."""
         t0 = time.monotonic_ns()
         inject(fault_sites.SERVING_INGEST_DECODE)
-        rgb, depth, fmt = decode_request(request)
+        rgb, depth, fmt = decode_request(request, onchip=self.onchip)
         t1 = time.monotonic_ns()
         dt = (t1 - t0) / 1e9
         obs.DECODE_SECONDS.labels(format=fmt).observe(dt)
         obs.HOST_STAGE_SPLIT.labels(stage="decode").observe(dt)
+        split = isinstance(rgb, entropy.CoefficientFrame)
+        if split:
+            # the host's half of the split decode: coefficient-payload
+            # unpack (format=2, ~frombuffer views) or the reference
+            # entropy decode of a JPEG (onchip mode)
+            obs.HOST_STAGE_SPLIT.labels(stage="entropy").observe(dt)
         tl = recorder_lib.Timeline("ingest", labels={
             "format": fmt,
             "mode": "pool" if self.workers else "inline",
         })
         root = tl.span("ingest", start_ns=t0, end_ns=t1)
-        tl.span("decode", start_ns=t0, end_ns=t1, parent=root)
+        tl.span("entropy" if split else "decode",
+                start_ns=t0, end_ns=t1, parent=root)
         self._recorder.record(tl)
         return rgb, depth, fmt
 

@@ -144,6 +144,7 @@ from robotic_discovery_platform_tpu.resilience import (
 )
 from robotic_discovery_platform_tpu.serving import (
     controller as controller_lib,
+    entropy as entropy_lib,
     fleet as fleet_lib,
     health as health_lib,
     ingest as ingest_lib,
@@ -302,10 +303,19 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         self.ingest = ingest_lib.DecodePool(
             ingest_lib.resolve_decode_workers(cfg.decode_workers),
             prefetch=cfg.ingest_prefetch,
+            onchip=ingest_lib.resolve_onchip_decode(cfg.onchip_decode),
         )
         if self.ingest.workers:
             log.info("ingest decode pool: %d worker(s), read-ahead %d",
                      self.ingest.workers, self.ingest.prefetch)
+        if self.ingest.onchip:
+            log.info("on-chip split decode: host entropy-decodes baseline "
+                     "JPEG; dequant+IDCT+upsample+color ride the device")
+        # direct-path (unbatched) decode+analyze graphs for
+        # coefficient-lane frames, memoized per (h, w, subsampling);
+        # rebuilt lazily after every engine swap (_make_engine clears it)
+        self._coef_direct: dict[tuple, Any] = {}  # guarded_by: _coef_direct_lock
+        self._coef_direct_lock = threading.Lock()
         self._geom_cache = ingest_lib.GeometryCache()
         # one scoped store for the reload poller's lifetime (thread-safe
         # to build here; rebuilding per poll would churn MLflow clients
@@ -776,6 +786,35 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
             forward=forward,
         )
+
+        # Coefficient-lane analyzer factory (split JPEG decode): builds
+        # the decode+analyze graph for one (geometry, subsampling), closed
+        # over THIS generation's model + variables. Shared by the batch
+        # dispatcher (lazily memoized per key) and the direct path
+        # (self._coef_direct). Default model only: zoo extras keep pixel
+        # formats -- their variables never ride this closure.
+        def coef_factory(model_key: str, height: int, width: int,
+                         subsampling: str, _model=model,
+                         _variables=variables, _forward=forward):
+            if model_key:
+                raise ValueError(
+                    "the coefficient lane serves the default model only; "
+                    f"model {model_key!r} frames must use pixel formats"
+                )
+            coef_analyze = pipeline.make_coef_batch_analyzer(
+                _model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
+                forward=_forward, height=height, width=width,
+                subsampling=subsampling,
+            )
+            return (lambda y, cb, cr, qy, qc, depths, intr, scales:
+                    coef_analyze(_variables, y, cb, cr, qy, qc, depths,
+                                 intr, scales))
+
+        self._coef_factory_fn = coef_factory
+        with self._coef_direct_lock:
+            # stale closures must not outlive the generation that built
+            # them -- direct coef graphs rebuild lazily on first use
+            self._coef_direct.clear()
         dispatcher = None
         if cfg.batch_window_ms > 0:
             from robotic_discovery_platform_tpu.serving.batching import (
@@ -865,6 +904,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 admission=cfg.admission_policy,
                 placer=self.placer,
                 model_label=self.model_label,
+                coef_analyzer_factory=coef_factory,
             )
             # a hot-reload builds a FRESH dispatcher for the new default
             # generation; the zoo's extra models (whose generations did
@@ -1117,6 +1157,10 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
 
         inject(fault_sites.SERVING_ANALYZE)
         timer = timer or StageTimer()
+        # split-decode frames carry coefficients, not pixels: the device
+        # decodes them fused ahead of the analyzer (CoefficientFrame's
+        # .shape property keeps every geometry read below uniform)
+        coef = isinstance(rgb, entropy_lib.CoefficientFrame)
         h, w = rgb.shape[:2]
         # per-stream geometry cache: identical intrinsics content never
         # re-converts to float32 (and, on the direct path, never
@@ -1137,11 +1181,15 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 # other streams; the submit carries the caller's
                 # remaining deadline so a cancelled/expired client frees
                 # this thread instead of parking it on an unbounded wait
-                out = eng.dispatcher.submit(
+                submit = (eng.dispatcher.submit_coef if coef
+                          else eng.dispatcher.submit)
+                out = submit(
                     rgb, depth, geom.k_f32, self.depth_scale,
                     timeout_s=timeout_s,
                     model=entry.name if entry is not None else "",
                 )
+            elif coef:
+                out = self._analyze_coef_direct(rgb, depth, geom, entry)
             else:
                 # explicit H2D for the frame inputs: the jitted entry runs
                 # under the transfer guard, and relying on implicit
@@ -1184,11 +1232,41 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             obs.MODEL_ANOMALY_SCORE.observe(anomaly)
         res = _FrameResult(mean_k, max_k, spline, mask_png.tobytes(),
                            coverage, valid, margin, depth_valid, anomaly)
-        if entry is None:
+        if entry is None and not coef:
             # only default-model frames mirror to a rollout shadow: the
-            # shadow diff gates the DEFAULT generation's replacement
+            # shadow diff gates the DEFAULT generation's replacement --
+            # and only pixel frames can (a split-decode frame's RGB never
+            # materializes on the host, which is its point)
             self._mirror_shadow(rgb, depth, geom.k_f32, mask, res)
         return res
+
+    def _analyze_coef_direct(self, frame, depth, geom, entry):
+        """Direct-path (unbatched) ride for a coefficient-lane frame: the
+        batch-1 decode+analyze graph, lazily built + memoized per
+        (h, w, subsampling) for the current engine generation, with the
+        leading batch axis squeezed off the result tree."""
+        if entry is not None:
+            raise ValueError(
+                "the coefficient lane serves the default model only; "
+                f"model {entry.name!r} frames must use pixel formats"
+            )
+        key = (frame.height, frame.width, frame.subsampling)
+        with self._coef_direct_lock:
+            analyze = self._coef_direct.get(key)
+        if analyze is None:
+            analyze = self._coef_factory_fn(
+                "", frame.height, frame.width, frame.subsampling
+            )
+            with self._coef_direct_lock:
+                analyze = self._coef_direct.setdefault(key, analyze)
+        staged = pipeline.stage_coef_batch(
+            frame.y[None], frame.cb[None], frame.cr[None],
+            frame.qy[None], frame.qc[None], depth[None],
+            geom.k_f32[None],
+            np.asarray([self.depth_scale], np.float32),
+        )
+        out = analyze(*staged)
+        return jax.tree.map(lambda a: a[0], out)
 
     def _observe_drift(self, res: _FrameResult,
                        entry=None) -> None:
@@ -1729,11 +1807,66 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         # never serves a frame (per zoo model: each entry gates against
         # its OWN pristine f32 pair)
         self._parity_gate(width, height)
+        if self.ingest.onchip:
+            # on-chip split decode: every baseline JPEG this server
+            # admits rides the coefficient lane, so readiness must also
+            # imply THOSE graphs are compiled -- otherwise the first
+            # live burst pays the fused decode+analyze compilation
+            # inside its frame deadlines
+            self.warmup_coef(width, height)
         # readiness flips ONLY here: a probe sees SERVING once the first
         # real frame path has compiled and run, never before
         self.mark_ready()
         log.info("warmed up %dx%d analyzer on %s", width, height,
                  jax.default_backend())
+
+    def warmup_coef(self, width: int, height: int,
+                    subsampling: str = "420") -> None:
+        """Pre-compile the coefficient-lane (``format = 2``) graphs for a
+        camera geometry: the direct single-frame decode+analyze when the
+        server has no dispatcher, otherwise every reachable bucket via
+        ``warm_coef`` (the same bucket sweep ``_warm_engine`` runs for
+        the pixel lane). ``warmup()`` calls this automatically when the
+        server itself runs with on-chip decode enabled; benches and
+        deployments whose CLIENTS ship ``format = 2`` against a
+        pixel-decode server call it explicitly before load arrives."""
+        import cv2
+
+        color, depth = _warm_frames(width, height)
+        sf = {
+            "444": cv2.IMWRITE_JPEG_SAMPLING_FACTOR_444,
+            "420": cv2.IMWRITE_JPEG_SAMPLING_FACTOR_420,
+            "422": cv2.IMWRITE_JPEG_SAMPLING_FACTOR_422,
+        }[subsampling]
+        ok, jpg = cv2.imencode(
+            ".jpg", color[..., ::-1],
+            [int(cv2.IMWRITE_JPEG_SAMPLING_FACTOR), int(sf)],
+        )
+        if not ok:
+            raise ValueError("warm-up coefficient encode failed")
+        cf = entropy_lib.parse_jpeg(jpg.tobytes())
+        dispatcher = self._engine.dispatcher
+        if dispatcher is None:
+            # direct (unbatched) path: exercising one coefficient frame
+            # memoizes its decode+analyze graph in _coef_direct
+            self._analyze_frame(cf, depth)
+            return
+        k = np.asarray(
+            self.intrinsics if self.intrinsics is not None
+            else _default_intrinsics(width, height), np.float32,
+        )
+        sizes, b = set(), 1
+        while b < self.cfg.max_batch:
+            sizes.add(dispatcher.bucket_for(b))
+            b *= 2
+        sizes.add(dispatcher.bucket_for(self.cfg.max_batch))
+        for b in sorted(sizes):
+            dispatcher.warm_coef(
+                cf,
+                np.zeros((b, height, width), np.uint16),
+                np.repeat(k[None], b, 0),
+                np.full((b,), self.depth_scale, np.float32),
+            )
 
     def _warm_zoo(self, width: int, height: int) -> None:
         """Capped eager warm for the non-default zoo entries."""

@@ -268,6 +268,16 @@ class ServerConfig:
     # (bounds per-stream decoded-frame memory; only meaningful with
     # decode_workers > 0).
     ingest_prefetch: int = 2
+    # Split JPEG decode (serving/entropy.py + ops/pallas/decode.py):
+    # when True, baseline-JPEG color payloads are entropy-decoded on the
+    # host to quantized coefficient blocks and the pixel half (dequant +
+    # IDCT + chroma upsample + YCbCr->RGB) runs fused ahead of the
+    # analyzer on the device -- decoded images never materialize on the
+    # host. This is the pure-Python REFERENCE mode; the production split
+    # is clients shipping Image.format = 2 coefficient payloads
+    # (client.encode_request(fmt="coef")), which the server accepts
+    # regardless of this flag. The RDP_ONCHIP_DECODE env var overrides.
+    onchip_decode: bool = False
     # Model forward implementation: "auto" = Pallas-fused kernels on TPU,
     # Flax/XLA elsewhere; "flax" / "pallas" force one path (ops/pallas).
     model_forward: str = "auto"

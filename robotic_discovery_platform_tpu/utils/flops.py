@@ -121,6 +121,80 @@ def bspline_curvature_roofline_ms(n: int, c: int, d: int = 3,
     )
 
 
+def jpeg_dequant_roofline_ms(n_blocks: int, batch: int = 1) -> dict:
+    """Roofline for the standalone dequantize stage (one int multiply per
+    coefficient against the broadcast [64] quant row): read int16
+    coefficients, write int32 products. Counted separately only for the
+    analytic table -- the shipped kernel fuses it into the IDCT matmuls,
+    which is why the fused bound below charges the int16 read once."""
+    n = batch * n_blocks * 64
+    return roofline_ms(n, 2 * n + 4 * n)
+
+
+def jpeg_idct_roofline_ms(n_blocks: int, batch: int = 1) -> dict:
+    """Roofline for the fused dequant+IDCT launch
+    (ops/pallas/decode.dequant_idct): two [N, 64] x [64, 64] integer basis
+    matmuls per pass over the block axis (islow's two passes), plus the
+    dequant multiply and the descale/clamp elementwise tail, against
+    reading the int16 coefficients + [64] quant row once and writing the
+    int32 samples once. At 64 blocks of reuse per basis element the
+    arithmetic intensity is ~43 FLOP/byte of coefficient traffic, yet the
+    tiny 64-wide contractions leave the MXU idle enough that the launch
+    stays bandwidth-bound at every deployed shape -- which is the point:
+    the decode stage must ride free under the analyzer's compute."""
+    n = batch * n_blocks
+    matmul_flops = 2 * (2 * n * 64 * 64)
+    elementwise_flops = 3 * n * 64  # dequant mul + two descale add/shifts
+    return roofline_ms(
+        matmul_flops + elementwise_flops,
+        2 * n * 64 + 2 * 64 + 4 * n * 64,
+    )
+
+
+def chroma_upsample_roofline_ms(h: int, w: int, batch: int = 1,
+                                subsampling: str = "420") -> dict:
+    """Roofline for the fancy (triangle) chroma upsample of both chroma
+    planes to the [H, W] luma grid: ~6 integer VPU ops per output sample
+    (two neighbor adds, two scaled sums, bias, shift) per plane, against
+    reading the subsampled planes and writing the full-resolution ones."""
+    if subsampling == "444":
+        return roofline_ms(0, 0)
+    div = 4 if subsampling == "420" else 2
+    in_px = 2 * batch * h * w // div
+    out_px = 2 * batch * h * w
+    return roofline_ms(6 * out_px, 4 * (in_px + out_px))
+
+
+def ycbcr_to_rgb_roofline_ms(h: int, w: int, batch: int = 1) -> dict:
+    """Roofline for the fixed-point YCbCr->RGB convert + clamp: ~12
+    integer VPU ops per pixel against reading three int32 planes and
+    writing the uint8 RGB image."""
+    px = batch * h * w
+    return roofline_ms(12 * px, 4 * 3 * px + 3 * px)
+
+
+def jpeg_decode_roofline_ms(h: int, w: int, batch: int = 1,
+                            subsampling: str = "420") -> dict:
+    """Combined roofline for the whole on-chip decode stage
+    (ops/pipeline.decode_coef_batch): dequant+IDCT over every block of all
+    three components, chroma upsample, color convert. The gate
+    bench_pallas.py applies: this stage must be bandwidth-bound (bound_by
+    == "memory") -- decode rides the analyzer's HBM streams, it does not
+    compete for its MXU."""
+    sh, sv = {"444": (1, 1), "420": (2, 2), "422": (2, 1)}[subsampling]
+    mcux = -(-w // (8 * sh))
+    mcuy = -(-h // (8 * sv))
+    blocks_y = (mcuy * sv) * (mcux * sh)
+    blocks_c = 2 * mcuy * mcux
+    idct = jpeg_idct_roofline_ms(blocks_y + blocks_c, batch)
+    ups = chroma_upsample_roofline_ms(h, w, batch, subsampling)
+    ycc = ycbcr_to_rgb_roofline_ms(h, w, batch)
+    return roofline_ms(
+        idct["flops"] + ups["flops"] + ycc["flops"],
+        idct["bytes"] + ups["bytes"] + ycc["bytes"],
+    )
+
+
 def unet_forward_flops(img_size: int = 256, base: int = 64,
                        in_ch: int = 3, num_classes: int = 1,
                        bilinear: bool = True) -> int:
