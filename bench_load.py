@@ -713,7 +713,15 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
     ``wire_bytes_per_frame``. The headlines: the before->after reduction
     in host-side microseconds (decode + staging) and the before->coef
     reduction in host-side DECODE microseconds (the JPEG-wire leg's
-    imdecode cost vs the coefficient leg's byte routing)."""
+    imdecode cost vs the coefficient leg's byte routing).
+
+    Four more legs profile the EGRESS overhaul on the raw ingest wire:
+    ``egress_before`` (device pack stage off, inline PNG encode),
+    ``egress_png`` / ``egress_bits`` / ``egress_rle`` (packed D2H, the
+    encode pool, response mask_format 0/1/2). The headline is the
+    before->bits reduction in per-frame D2H + encode microseconds plus
+    the per-format response mask payload bytes; both land under
+    ``host_profile.egress`` for the CI egress-smoke gate."""
     import grpc
 
     from robotic_discovery_platform_tpu.io.frames import SyntheticSource
@@ -783,6 +791,71 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
             server.stop(grace=None)
             servicer.close()
 
+    # -- egress legs (PR 20): the response-path mirror of the ingest
+    # comparison, all on the raw ingest wire so decode cost is constant.
+    # "egress_before" disables the device pack stage (the pre-pack
+    # FrameAnalysis multi-leaf fetch + inline PNG encode); the packed
+    # legs differ only in the response mask_format (0=PNG through the
+    # encode pool, 1=bits, 2=RLE). The gated numbers: per-frame d2h +
+    # encode microseconds (before vs bits) and the response mask payload
+    # bytes per leg (PNG vs packed).
+    egress_legs = (
+        ("egress_before", {"egress_pack": False, "egress_workers": 0}, 0),
+        ("egress_png", {"egress_workers": 4}, 0),
+        ("egress_bits", {"egress_workers": 4}, 1),
+        ("egress_rle", {"egress_workers": 4}, 2),
+    )
+    egress_profiles: dict[str, dict] = {}
+    mask_bytes: dict[str, int] = {}
+    for name, extra, mf in egress_legs:
+        server, servicer, address = boot_smoke_server(
+            slo_ms, decode_workers=after_workers, extra_cfg=extra)
+        channel = grpc.insecure_channel(address)
+        stub = vision_grpc.VisionAnalysisServiceStub(channel)
+        try:
+            request = client_lib.encode_request(
+                color, depth, fmt="raw", mask_format=mf)
+            for _ in range(3):
+                try:
+                    resps = list(
+                        stub.AnalyzeActuatorPerformance(iter([request]))
+                    )
+                    if any(r.status.startswith("ERROR") for r in resps):
+                        warm_errors += 1
+                except Exception:
+                    warm_errors += 1
+            servicer.warmup(w, h)
+            # one probe response records the leg's mask payload size
+            # (identical frame on every leg, so the ratios are exact)
+            probe = list(stub.AnalyzeActuatorPerformance(iter([request])))
+            if probe and not probe[0].status.startswith("ERROR"):
+                mask_bytes[name] = len(probe[0].mask)
+            snap0 = _host_snapshot()
+            arrivals = poisson_arrivals(
+                rate, duration, np.random.default_rng(cli.seed))
+            lat_ms, errors, wall = run_level(
+                stub, request, arrivals, cli.workers, deadline_s)
+            prof = host_profile_delta(snap0, _host_snapshot())
+            row = summarize_level(lat_ms, errors, rate, wall, slo_ms)
+            row["host_leg"] = name
+            row["decode_workers"] = after_workers
+            row["wire_format"] = "raw"
+            row["mask_format"] = mf
+            row["wire_bytes_per_frame"] = request.ByteSize()
+            row["response_mask_bytes"] = mask_bytes.get(name, 0)
+            row["host_profile"] = prof
+            rows.append(row)
+            egress_profiles[name] = prof
+            print(f"# host leg={name} mask_format={mf} "
+                  f"resp_mask={mask_bytes.get(name, 0)}B "
+                  f"d2h_us={prof['split_us']['d2h']} "
+                  f"encode_us={prof['split_us']['encode']}",
+                  file=sys.stderr)
+        finally:
+            channel.close()
+            server.stop(grace=None)
+            servicer.close()
+
     before, after = profiles["before"], profiles["after"]
     coef = profiles.get("coef")
     reduction = (1.0 - after["host_us"] / before["host_us"]
@@ -814,6 +887,40 @@ def run_host_profile(cli, slo_ms: float, deadline_s: float | None,
         host_block["coef_host_reduction_pct"] = round(
             100.0 * (1.0 - coef["host_us"] / before["host_us"])
             if before["host_us"] > 0 else 0.0, 1)
+
+    if egress_profiles:
+        # egress headline: per-frame response-path host microseconds
+        # (D2H fetch + mask encode) on the pre-pack leg vs the packed
+        # bits leg, and the response mask payload per format. The CI
+        # egress-smoke gate reads egress_reduction_pct (>= 30) and
+        # wire_ratio_png_over_rle (>= 4; RLE, not bits -- bitpacked rows
+        # are fixed-size and can exceed PNG on sparse masks).
+        def _d2h_encode(p: dict) -> float:
+            return p["split_us"]["d2h"] + p["split_us"]["encode"]
+
+        eg_before = _d2h_encode(egress_profiles["egress_before"])
+        eg_packed = _d2h_encode(egress_profiles["egress_bits"])
+        egress_block = {
+            "legs": egress_profiles,
+            "d2h_encode_us": {n: round(_d2h_encode(p), 2)
+                              for n, p in egress_profiles.items()},
+            "d2h_encode_us_before": round(eg_before, 2),
+            "d2h_encode_us_packed": round(eg_packed, 2),
+            "egress_reduction_pct": round(
+                100.0 * (1.0 - eg_packed / eg_before)
+                if eg_before > 0 else 0.0, 1),
+            "response_mask_bytes": mask_bytes,
+        }
+        png_b = mask_bytes.get("egress_png", 0)
+        rle_b = mask_bytes.get("egress_rle", 0)
+        bits_b = mask_bytes.get("egress_bits", 0)
+        if png_b and rle_b:
+            egress_block["wire_ratio_png_over_rle"] = round(
+                png_b / rle_b, 2)
+        if png_b and bits_b:
+            egress_block["wire_ratio_png_over_bits"] = round(
+                png_b / bits_b, 2)
+        host_block["egress"] = egress_block
 
     import jax
 

@@ -444,6 +444,59 @@ def bench_decode(rt_ms: float) -> list[dict]:
     return rows
 
 
+def bench_egress(rt_ms: float) -> list[dict]:
+    """Egress mask bitpack (ops/pallas/pack.bitpack_mask) vs the XLA
+    fallback at the serving mask shape (480x640) -- the device half of
+    the one-fetch egress wire (serving/egress.py).
+
+    Both backends run the same _pack_math arithmetic (results bitwise
+    identical; tests/test_egress.py), so the race is pure schedule. The
+    gate: the roofline must classify as bandwidth-bound (``bound_by ==
+    "memory"``) -- packing is one HBM pass over the mask and must ride
+    free under the analyzer's compute, the same contract the decode
+    stage pins on the way in."""
+    from robotic_discovery_platform_tpu.ops.pallas import pack as pack_lib
+    from robotic_discovery_platform_tpu.utils import flops as flops_lib
+
+    rng = np.random.default_rng(5)
+    rows = []
+    h, w = 480, 640
+    wb = pack_lib.packed_row_bytes(w)
+    for b in (1, 8):
+        mask0 = jnp.asarray(rng.integers(0, 2, (b, h, w)), jnp.uint8)
+
+        def step_for(impl, b=b):
+            def step(m):
+                p = pack_lib.bitpack_mask(m, impl=impl)
+                # unpack in-graph back to a mask-shaped feed, so the
+                # chain is data-dependent and shape-stable
+                bits = (p[..., None] >> jnp.arange(7, -1, -1,
+                                                   dtype=jnp.uint8)) & 1
+                return bits.reshape(b, h, wb * 8)[..., :w]
+            return step
+
+        t_p = _time_chain(step_for("pallas"), mask0, rt_ms)
+        t_x = _time_chain(step_for("xla"), mask0, rt_ms)
+        roof = flops_lib.mask_bitpack_roofline_ms(h, w, batch=b)
+        # the gate: packing must be bandwidth-bound at serving shapes
+        assert roof["bound_by"] == "memory", (
+            f"mask bitpack classified {roof['bound_by']!r}-bound at "
+            f"{h}x{w} b{b}; the egress design requires one bandwidth-"
+            "bound HBM pass (see utils/flops.mask_bitpack_roofline_ms)"
+        )
+        rows.append({
+            "op": "mask_bitpack", "b": b, "h": h, "w": w,
+            "pallas_ms": round(t_p, 4), "xla_ms": round(t_x, 4),
+            "speedup": round(t_x / t_p, 3),
+            **_roofline_fields(roof, t_p, t_x),
+        })
+        print(f"# mask_bitpack b{b} {h}x{w}: pallas={t_p:.3f}ms "
+              f"xla={t_x:.3f}ms x{t_x / t_p:.2f} "
+              f"roof={roof['bound_ms']:.3f}ms ({roof['bound_by']})",
+              file=sys.stderr)
+    return rows
+
+
 def bench_full_forward(rt_ms: float) -> dict:
     from robotic_discovery_platform_tpu.models.unet import build_unet, init_unet
     from robotic_discovery_platform_tpu.ops.pallas import make_pallas_unet
@@ -627,6 +680,7 @@ def main() -> None:
         "heads": _section("heads", bench_heads, rt_ms),
         "geometry": _section("geometry", bench_geometry, rt_ms),
         "decode": _section("decode", bench_decode, rt_ms),
+        "egress": _section("egress", bench_egress, rt_ms),
         "full_forward_b1_256": _section(
             "full_forward", bench_full_forward, rt_ms),
         "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

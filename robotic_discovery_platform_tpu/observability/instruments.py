@@ -296,6 +296,35 @@ HOST_STAGE_SPLIT = REGISTRY.histogram(
     ("stage",),
 )
 
+# -- host-path egress (serving/egress.py) ------------------------------------
+
+ENCODE_SECONDS = REGISTRY.histogram(
+    families.ENCODE_SECONDS,
+    "Actual per-frame response-mask encode work (wherever it ran: "
+    "encode worker or inline handler thread), by response wire format "
+    "(png = legacy cv2.imencode, bits = packed-bits header+rows, rle = "
+    "run-length).",
+    ("format",),
+)
+EGRESS_BYTES = REGISTRY.counter(
+    families.EGRESS_BYTES,
+    "Response mask payload bytes put on the wire, by mask_format "
+    "(png/bits/rle) -- the fleet-wide relay-bandwidth meter the packed "
+    "formats exist to shrink.",
+    ("format",),
+)
+EGRESS_QUEUE_DEPTH = REGISTRY.gauge(
+    families.EGRESS_QUEUE_DEPTH,
+    "Frames waiting in the encode worker pool's queue (0 with inline "
+    "encode, ServerConfig.egress_workers = 0).",
+)
+EGRESS_POOL_SIZE = REGISTRY.gauge(
+    families.EGRESS_POOL_SIZE,
+    "Free pooled egress staging buffers (packed-dispatch D2H landing "
+    "rows) across all payload shapes; capped like the batch staging "
+    "pool, sustained shrink means lost PackedResult releases.",
+)
+
 # -- batching ----------------------------------------------------------------
 
 BATCH_QUEUE_DEPTH = REGISTRY.gauge(

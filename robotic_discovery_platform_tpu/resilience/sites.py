@@ -37,6 +37,10 @@ SERVING_BATCH_COMPLETE = "serving.batch.complete"
 SERVING_INGEST_DECODE = "serving.ingest.decode"
 #: the ingest pipeline loop
 SERVING_INGEST_LOOP = "serving.ingest.loop"
+#: the encode worker pool's per-frame response encode
+SERVING_EGRESS_ENCODE = "serving.egress.encode"
+#: the egress encode-pool worker loop
+SERVING_EGRESS_LOOP = "serving.egress.loop"
 
 
 def chip_dispatch(chip: int) -> str:
@@ -66,6 +70,8 @@ ALL_SITES = (
     SERVING_BATCH_COMPLETE,
     SERVING_INGEST_DECODE,
     SERVING_INGEST_LOOP,
+    SERVING_EGRESS_ENCODE,
+    SERVING_EGRESS_LOOP,
 )
 
 SITE_PATTERNS = (

@@ -160,6 +160,7 @@ from robotic_discovery_platform_tpu.resilience import (
 from robotic_discovery_platform_tpu.resilience import (
     sites as fault_sites,
 )
+from robotic_discovery_platform_tpu.serving import egress as egress_lib
 from robotic_discovery_platform_tpu.serving import entropy
 from robotic_discovery_platform_tpu.serving.admission import (
     DeadlineQueue,
@@ -629,6 +630,37 @@ class _CoefBucketBuffers:
             self.scales[n:] = self.scales[0]
 
 
+class _EgressStaging:
+    """One packed dispatch's pooled host landing buffer, refcounted.
+
+    The completer copies its single D2H fetch (the ``[B, P]`` uint8
+    packed payload) into a pooled :func:`_aligned_empty` buffer and
+    hands each live frame a zero-copy row view
+    (serving/egress.PackedResult) plus this object's ``release_one`` as
+    the release callback; the LAST release returns the buffer to the
+    dispatcher's egress pool. Completing on behalf of frames whose
+    waiter already gave up keeps the count exact in the common case; a
+    release lost to the abandon race costs the pool one buffer, never
+    correctness -- the buffer is plain GC'd memory and is only re-pooled
+    once every row view's holder has called release."""
+
+    __slots__ = ("buf", "_remaining", "_lock", "_pool_put")
+
+    def __init__(self, buf: np.ndarray, n: int,
+                 pool_put: Callable[[np.ndarray], None]):
+        self.buf = buf
+        self._remaining = n  # guarded_by: _lock
+        self._lock = threading.Lock()
+        self._pool_put = pool_put
+
+    def release_one(self) -> None:
+        with self._lock:
+            self._remaining -= 1
+            last = self._remaining == 0
+        if last:
+            self._pool_put(self.buf)
+
+
 @dataclass(eq=False)
 class _Dispatch:
     """A launched-but-not-completed batch riding the completion queue."""
@@ -896,6 +928,13 @@ class BatchDispatcher:
         self._pool: dict[tuple, list[_BucketBuffers]] = {}  # guarded_by: _pool_lock
         self._pool_cap = self._max_inflight * self._n_windows + 1
         self._pool_lock = checked_lock("batching.pool")
+        # pooled 64-byte-aligned landing buffers for packed egress
+        # payloads, keyed by the fetched [B, P] shape: the completer's
+        # single D2H per packed dispatch copies in here and stream
+        # handlers read zero-copy row views until the refcounted release
+        # (_EgressStaging) returns the buffer. Shares _pool_lock and the
+        # _pool_cap leak bound.
+        self._egress_pool: dict[tuple, list[np.ndarray]] = {}  # guarded_by: _pool_lock
         obs.SERVING_CHIPS.set(router.chips if router is not None else 1)
         self._stopped = threading.Event()
         self._submit_lock = checked_lock("batching.submit")
@@ -1368,6 +1407,34 @@ class BatchDispatcher:
                 free.append(bufs)
             obs.BATCH_POOL_SIZE.set(sum(len(v) for v in self._pool.values()))
 
+    def _egress_take(self, shape: tuple) -> np.ndarray:
+        """A pooled aligned landing buffer for one packed dispatch's
+        single D2H fetch (``[B, P]`` uint8)."""
+        with self._pool_lock:
+            free = self._egress_pool.get(shape)
+            if free:
+                buf = free.pop()
+                obs.EGRESS_POOL_SIZE.set(
+                    sum(len(v) for v in self._egress_pool.values())
+                )
+                return buf
+        return _aligned_empty(shape, np.uint8)
+
+    def _egress_put(self, buf: np.ndarray) -> None:
+        """Return a fully released egress staging buffer to the free
+        list (called by the LAST frame's ``PackedResult.release``,
+        usually from a stream-handler thread)."""
+        with self._pool_lock:
+            free = self._egress_pool.setdefault(buf.shape, [])
+            # same leak bound as _pool_put: beyond one buffer per
+            # possible in-flight dispatch (plus one), growth means lost
+            # releases -- drop and let the gauge show it
+            if len(free) < self._pool_cap:
+                free.append(buf)
+            obs.EGRESS_POOL_SIZE.set(
+                sum(len(v) for v in self._egress_pool.values())
+            )
+
     # -- mesh routing --------------------------------------------------------
 
     def _allowed_chips(self, model: str) -> set[int] | None:
@@ -1824,15 +1891,46 @@ class BatchDispatcher:
                 return
             pop_ns = time.monotonic_ns()
             t_pop = pop_ns / 1e9
+            t_ready = t_pop
             try:
                 inject(fault_sites.SERVING_BATCH_COMPLETE)
+                # drain the async device ride BEFORE the timed fetch:
+                # np.asarray on a still-computing jax value would charge
+                # the tail of device compute to the d2h split, burying
+                # the actual transfer + fan-out cost it gates on
+                jax.block_until_ready(d.out)
+                t_ready = time.monotonic()
                 # the ONE blocking host fetch, off the collector's critical
                 # path: batch N+1 is already staging/computing while this
                 # D2H + fan-out runs
-                host = jax.tree.map(np.asarray, d.out)
-                for i, p in enumerate(d.group):
-                    p.result = jax.tree.map(lambda a, _i=i: a[_i], host)
-                    p.done.set()
+                if isinstance(d.out, jax.Array):
+                    # packed egress payload ([B, P] uint8, ops/pallas/
+                    # pack.py layout): literally one fetch for the whole
+                    # dispatch, landing in a pooled aligned staging
+                    # buffer. Frames get zero-copy row views; the last
+                    # PackedResult.release returns the buffer.
+                    fetched = np.asarray(d.out)
+                    staging = self._egress_take(fetched.shape)
+                    np.copyto(staging, fetched)
+                    share = _EgressStaging(staging, len(d.group),
+                                           self._egress_put)
+                    for i, p in enumerate(d.group):
+                        if p.done.is_set() or p.abandoned:
+                            # the waiter already gave up (deadline or
+                            # watchdog): nobody will ever release this
+                            # row's share, so release it on their behalf
+                            share.release_one()
+                            continue
+                        p.result = egress_lib.PackedResult(
+                            staging[i], release=share.release_one
+                        )
+                        p.done.set()
+                else:
+                    host = jax.tree.map(np.asarray, d.out)
+                    for i, p in enumerate(d.group):
+                        p.result = jax.tree.map(lambda a, _i=i: a[_i],
+                                                host)
+                        p.done.set()
                 # one completed ride = one per-frame service-time sample
                 # (staging through D2H), keyed per (model, bucket): what
                 # the admission shed and the eviction margin consult --
@@ -1878,13 +1976,13 @@ class BatchDispatcher:
                 obs.BATCH_STAGE_LATENCY.labels(stage="complete").observe(
                     done_t - t_pop
                 )
-                # host split: launch -> completer pop approximates the
-                # device-side ride; pop -> done is the blocking D2H +
-                # fan-out the completer pays on the host
+                # host split: launch -> result-ready is the device-side
+                # ride; ready -> done is the D2H fetch + fan-out the
+                # completer pays on the host (the egress-gated number)
                 obs.HOST_STAGE_SPLIT.labels(stage="device").observe(
-                    max(0.0, t_pop - d.launch_t))
+                    max(0.0, t_ready - d.launch_t))
                 obs.HOST_STAGE_SPLIT.labels(stage="d2h").observe(
-                    done_t - t_pop)
+                    max(0.0, done_t - t_ready))
                 self._pool_put(d.bufs)
                 with self._inflight_lock:
                     self._inflight_count = max(0, self._inflight_count - 1)

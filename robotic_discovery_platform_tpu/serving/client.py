@@ -30,6 +30,7 @@ from robotic_discovery_platform_tpu.resilience import RetryPolicy, inject
 from robotic_discovery_platform_tpu.resilience import (
     sites as fault_sites,
 )
+from robotic_discovery_platform_tpu.serving import egress
 from robotic_discovery_platform_tpu.serving.proto import vision_grpc, vision_pb2
 from robotic_discovery_platform_tpu.utils.config import ClientConfig
 from robotic_discovery_platform_tpu.utils.logging import get_logger
@@ -46,14 +47,21 @@ class FrameResult:
     status: str
     mask_coverage: float
     proc_time_ms: float
+    #: the raw response ``mask`` payload (PNG bytes on the legacy wire;
+    #: a packed-bits / RLE payload when the request asked for one)
     mask_png: bytes
     spline_points: np.ndarray  # [N, 3]
     frame_bgr: np.ndarray | None = None
+    #: the decoded [H, W] uint8 0/1 mask when the response carried a
+    #: packed payload (serving/egress.decode_mask_wire) -- the EXACT
+    #: mask the analyzer emitted; None on the legacy PNG wire
+    mask: np.ndarray | None = None
 
 
 def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
                    fmt: str = "encoded",
-                   model: str = "") -> vision_pb2.AnalysisRequest:
+                   model: str = "",
+                   mask_format: int = 0) -> vision_pb2.AnalysisRequest:
     """Build one wire request from a BGR frame + z16 depth frame.
 
     ``fmt="encoded"`` (default) is the historical JPEG/PNG pair (lossy
@@ -76,7 +84,15 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
 
     ``model`` selects the model-zoo entry by name (serving/zoo.py);
     "" (default) is the server's default model, and serializes to ZERO
-    extra wire bytes -- a legacy request is bitwise identical."""
+    extra wire bytes -- a legacy request is bitwise identical.
+
+    ``mask_format`` selects the RESPONSE mask encoding
+    (serving/egress.py): 0 (default) is the historical PNG bytes --
+    serializing to zero extra wire bytes, so a legacy request stays
+    bitwise identical -- 1 asks for the packed-bits payload and 2 for
+    RLE; both decode back to the exact uint8 mask
+    (``FrameResult.mask``), and the spline rides ``packed_spline`` as
+    f32 triples instead of per-point Point3D messages."""
     import cv2
 
     h, w = color_bgr.shape[:2]
@@ -100,6 +116,7 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
                 format=ingest.FORMAT_RAW,
             ),
             model=model,
+            mask_format=mask_format,
         )
     if fmt == "raw":
         from robotic_discovery_platform_tpu.serving import ingest
@@ -116,6 +133,7 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
                 format=ingest.FORMAT_RAW,
             ),
             model=model,
+            mask_format=mask_format,
         )
     if fmt != "encoded":
         raise ValueError(f"unknown request format {fmt!r}; "
@@ -128,14 +146,16 @@ def encode_request(color_bgr: np.ndarray, depth: np.ndarray,
         color_image=vision_pb2.Image(data=jpg.tobytes(), width=w, height=h),
         depth_image=vision_pb2.Image(data=png.tobytes(), width=w, height=h),
         model=model,
+        mask_format=mask_format,
     )
 
 
 def generate_requests(source: FrameSource, frame_queue: deque,
-                      max_frames: int | None = None):
+                      max_frames: int | None = None,
+                      mask_format: int = 0):
     for color, depth in iter_frames(source, max_frames):
         frame_queue.append(color)
-        yield encode_request(color, depth)
+        yield encode_request(color, depth, mask_format=mask_format)
 
 
 def overlay(frame_bgr: np.ndarray, result: FrameResult,
@@ -145,7 +165,14 @@ def overlay(frame_bgr: np.ndarray, result: FrameResult,
     import cv2
 
     vis = frame_bgr.copy()
-    if result.mask_png:
+    if result.mask is not None:
+        # packed wire formats arrive pre-decoded as the exact 0/1 mask
+        mask = result.mask * np.uint8(255)
+        if mask.shape == vis.shape[:2]:
+            red = np.zeros_like(vis)
+            red[..., 2] = mask
+            vis = cv2.addWeighted(vis, 1.0, red, 0.4, 0)
+    elif result.mask_png:
         mask = cv2.imdecode(np.frombuffer(result.mask_png, np.uint8),
                             cv2.IMREAD_GRAYSCALE)
         if mask is not None and mask.shape == vis.shape[:2]:
@@ -175,9 +202,16 @@ def run_client(
     display: bool = False,
     channel: grpc.Channel | None = None,
     retry: RetryPolicy | None = None,
+    mask_format: int = 0,
 ) -> list[FrameResult]:
     """Stream frames, return per-frame results. ``display=True`` opens the
     live overlay window ('q' quits, reference client.py:138-140).
+
+    ``mask_format`` selects the response mask encoding (0 = legacy PNG,
+    1 = packed bits, 2 = RLE; serving/egress.py). Packed responses are
+    decoded back to the exact uint8 mask (``FrameResult.mask``) and the
+    spline is read off the f32 ``packed_spline`` payload instead of the
+    per-point Point3D message loop.
 
     Stream SETUP rides the shared RetryPolicy: UNAVAILABLE before the
     first response (server restarting, port not up yet) backs off and
@@ -217,13 +251,20 @@ def run_client(
         with trace.span("client.stream") as sp:
             log.info("streaming to %s", cfg.server_address)
             responses = stub.AnalyzeActuatorPerformance(
-                generate_requests(source, frame_queue, max_frames),
+                generate_requests(source, frame_queue, max_frames,
+                                  mask_format=mask_format),
                 metadata=trace.to_metadata(sp.context),
             )
             for response in responses:
                 frame = frame_queue.popleft() if frame_queue else None
                 mean_window.append(response.mean_curvature)
                 max_window.append(response.max_curvature)
+                if response.packed_spline:
+                    spline = egress.decode_spline_wire(response.packed_spline)
+                else:
+                    spline = np.array(
+                        [[p.x, p.y, p.z] for p in response.spline_points]
+                    ).reshape(-1, 3)
                 result = FrameResult(
                     mean_curvature=response.mean_curvature,
                     max_curvature=response.max_curvature,
@@ -233,10 +274,9 @@ def run_client(
                     mask_coverage=response.mask_coverage,
                     proc_time_ms=response.proc_time_ms,
                     mask_png=response.mask,
-                    spline_points=np.array(
-                        [[p.x, p.y, p.z] for p in response.spline_points]
-                    ).reshape(-1, 3),
+                    spline_points=spline,
                     frame_bgr=frame,
+                    mask=egress.decode_mask_wire(response.mask),
                 )
                 results.append(result)
                 if display and frame is not None:

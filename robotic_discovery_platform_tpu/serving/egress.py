@@ -1,0 +1,551 @@
+"""Host-path egress: packed device results -> wire bytes, off the hot path.
+
+The egress twin of :mod:`ingest`. PRs 12 and 19 made the ingest half of
+the serving path nearly free; this module removes the mirror-image cost
+on the way OUT:
+
+1. **Packed results** (:class:`PackedResult`): with the pack stage fused
+   into the analyzer graph (ops/pipeline.pack_analysis), the batch
+   completer performs ONE D2H fetch per dispatch -- a ``[B, P]`` uint8
+   payload landing in a pooled 64-byte-aligned staging buffer -- instead
+   of ~5 separate ``np.asarray`` fetches per frame. Each frame's row is
+   self-describing (ops/pallas/pack.py layout: 16-byte header, f32
+   sidecar, bitpacked mask rows); :class:`PackedResult` is the zero-copy
+   parser plus the refcounted release that returns the staging buffer to
+   the dispatcher's pool once every frame of the dispatch has consumed
+   its row.
+
+2. **Wire-format mask payloads**: ``AnalysisRequest.mask_format``
+   selects what rides ``AnalysisResponse.mask``. The proto3 default 0 is
+   today's PNG bytes (legacy clients stay bitwise-identical on the
+   wire); 1 is the packed-bits payload (:func:`encode_bits_wire`: an
+   8-byte header + the bitpacked rows, a straight ``tobytes()`` of the
+   staging view -- no transform, no full-resolution mask on the host at
+   all); 2 is run-length encoding (:func:`encode_rle_wire`, the smallest
+   payload for the smooth masks segmenters emit). Both decode back to
+   the EXACT uint8 mask (:func:`decode_mask_wire`).
+
+3. **Encode pool** (:class:`EncodePool`): legacy PNG encoding --
+   ``cv2.imencode`` plus its full-frame ``mask * 255`` staging -- moves
+   into a bounded worker pool mirroring :class:`ingest.DecodePool`:
+   watchdog restart of dead workers, per-frame error-not-worker
+   semantics, ``workers=0`` = inline bitwise-parity mode.
+   ``ServerConfig.egress_workers`` / ``RDP_EGRESS_WORKERS`` size it.
+
+Fault-injection sites (resilience/faults.py): ``serving.egress.encode``
+fires inside the per-frame encode guard (an injected failure
+error-completes that frame only) and ``serving.egress.loop`` fires in
+the worker loop OUTSIDE the guard (kills the worker thread itself --
+the watchdog-restart drill).
+
+Observability: ``rdp_encode_seconds{format}`` (actual encode work,
+wherever it ran), ``rdp_egress_bytes_total{format}`` (response mask
+payload bytes by format), ``rdp_egress_pool_queue_depth``, and the
+``encode`` stage of ``rdp_host_stage_split_seconds`` (what
+``bench_load.py --host-profile`` reads).
+
+With ``egress_workers=0`` and ``mask_format=0`` the serial depth-1
+serving path stays bitwise-identical to the pre-egress server.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from robotic_discovery_platform_tpu.observability import (
+    events,
+    instruments as obs,
+    journal as journal_lib,
+    recorder as recorder_lib,
+)
+from robotic_discovery_platform_tpu.resilience import DeadlineExceeded, inject
+from robotic_discovery_platform_tpu.resilience import (
+    sites as fault_sites,
+)
+from robotic_discovery_platform_tpu.utils.lockcheck import checked_lock
+from robotic_discovery_platform_tpu.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_WORKERS_ENV_VAR = "RDP_EGRESS_WORKERS"
+
+#: ``AnalysisRequest.mask_format`` wire values (protos/vision.proto).
+#: The proto3 default of 0 keeps legacy clients bitwise on the wire.
+MASK_FORMAT_PNG = 0
+MASK_FORMAT_BITS = 1
+MASK_FORMAT_RLE = 2
+
+_FORMAT_NAMES = {MASK_FORMAT_PNG: "png", MASK_FORMAT_BITS: "bits",
+                 MASK_FORMAT_RLE: "rle"}
+
+#: wire headers of the packed mask payloads riding
+#: ``AnalysisResponse.mask`` (PNG payloads keep their own signature,
+#: which can never collide with these magics)
+_BITS_HEADER = struct.Struct("<4sHH")   # magic, height, width
+_RLE_HEADER = struct.Struct("<4sHHI")   # magic, height, width, runs
+WIRE_BITS_MAGIC = b"RDPB"
+WIRE_RLE_MAGIC = b"RDPR"
+
+
+def mask_format_name(mask_format: int) -> str:
+    """Metric label for a ``mask_format`` wire value."""
+    return _FORMAT_NAMES.get(int(mask_format), "unknown")
+
+
+def resolve_egress_workers(configured: int) -> int:
+    """The effective encode-pool width: ``RDP_EGRESS_WORKERS`` when set,
+    else ``ServerConfig.egress_workers``. 0 = inline encode in the
+    handler thread (the bitwise-parity serial mode); negative = one
+    worker per available CPU."""
+    raw = os.environ.get(_WORKERS_ENV_VAR)
+    value = int(raw) if raw else int(configured)
+    if value < 0:
+        return max(1, os.cpu_count() or 1)
+    return value
+
+
+# -- packed payload rows -----------------------------------------------------
+
+
+class PackedResult:
+    """One frame's packed analysis payload: a zero-copy parser over the
+    uint8 row the completer's single D2H fetch landed in pooled staging.
+
+    Row layout is ``ops/pallas/pack.py``'s (self-describing 16-byte
+    header + f32 sidecar + bitpacked mask rows). Scalars come off the
+    sidecar as the exact f32 values the legacy per-leaf fetches carried,
+    so the response stays bitwise; the full-resolution mask only ever
+    materializes on the host when something actually needs pixels (PNG
+    encode, the rollout shadow) via :meth:`unpack_mask`.
+
+    ``release`` hands the row back to the dispatcher's refcounted
+    staging pool -- call it exactly once, after consuming (or copying)
+    everything needed. A missed release only costs the pool one buffer
+    (Python GC still reclaims the memory once the row view dies); a
+    double release is ignored.
+    """
+
+    __slots__ = ("payload", "h", "w", "n_pts", "_release", "_released")
+
+    def __init__(self, payload: np.ndarray,
+                 release: Callable[[], None] | None = None):
+        from robotic_discovery_platform_tpu.ops.pallas import pack as pack_lib
+
+        payload = np.asarray(payload)
+        if payload.ndim != 1 or payload.dtype != np.uint8:
+            raise ValueError(
+                f"packed payload must be a 1-D uint8 row; got "
+                f"{payload.dtype} with shape {payload.shape}"
+            )
+        magic, h, w, n_pts = struct.unpack_from(
+            "<4sIII", memoryview(payload[:pack_lib.HEADER_BYTES])
+        )
+        if magic != pack_lib.ROW_MAGIC:
+            raise ValueError(
+                f"packed payload header magic {magic!r} != "
+                f"{pack_lib.ROW_MAGIC!r}"
+            )
+        expect = pack_lib.frame_payload_bytes(h, w, n_pts)
+        if payload.shape[0] != expect:
+            raise ValueError(
+                f"packed payload is {payload.shape[0]} bytes; header "
+                f"geometry ({h}x{w}, {n_pts} spline samples) needs {expect}"
+            )
+        self.payload = payload
+        self.h, self.w, self.n_pts = int(h), int(w), int(n_pts)
+        self._release = release
+        self._released = release is None
+
+    # -- layout views (zero-copy into the staging row) ----------------------
+
+    def _sidecar(self) -> np.ndarray:
+        from robotic_discovery_platform_tpu.ops.pallas import pack as pack_lib
+
+        n = pack_lib.sidecar_floats(self.n_pts)
+        lo = pack_lib.HEADER_BYTES
+        return self.payload[lo:lo + 4 * n].view(np.float32)
+
+    @property
+    def mask_bits(self) -> np.ndarray:
+        """[H, ceil(W/8)] uint8 view of the bitpacked mask rows."""
+        from robotic_discovery_platform_tpu.ops.pallas import pack as pack_lib
+
+        wb = pack_lib.packed_row_bytes(self.w)
+        lo = (pack_lib.HEADER_BYTES
+              + 4 * pack_lib.sidecar_floats(self.n_pts))
+        return self.payload[lo:lo + self.h * wb].reshape(self.h, wb)
+
+    # -- decoded fields ------------------------------------------------------
+
+    def scalars(self) -> tuple[float, float, float, bool, float]:
+        """(coverage, mean_curvature, max_curvature, valid, margin) --
+        python floats off the f32 sidecar, bitwise what the legacy
+        per-leaf fetches reported (invalid frames read 0.0 curvature)."""
+        s = self._sidecar()
+        return (float(s[0]), float(s[1]), float(s[2]), bool(s[3] != 0.0),
+                float(s[4]))
+
+    def spline(self) -> np.ndarray:
+        """[n_pts, 3] float32 spline block -- a fresh copy, safe to hold
+        after :meth:`release`. Empty [0, 3] when the profile is invalid
+        (the legacy host convention)."""
+        s = self._sidecar()
+        if s[3] == 0.0:
+            return np.zeros((0, 3), np.float32)
+        from robotic_discovery_platform_tpu.ops.pallas import pack as pack_lib
+
+        return np.array(
+            s[pack_lib.N_SCALARS:].reshape(self.n_pts, 3), copy=True
+        )
+
+    def spline_wire(self) -> bytes:
+        """The packed-spline response payload: little-endian f32 (x, y, z)
+        triples, empty when the profile is invalid."""
+        s = self._sidecar()
+        if s[3] == 0.0:
+            return b""
+        from robotic_discovery_platform_tpu.ops.pallas import pack as pack_lib
+
+        return s[pack_lib.N_SCALARS:].tobytes()
+
+    def unpack_mask(self) -> np.ndarray:
+        """[H, W] uint8 0/1 mask -- the exact mask the analyzer emitted
+        (np.unpackbits is the bitwise inverse of the device pack)."""
+        return np.unpackbits(self.mask_bits, axis=1)[:, :self.w]
+
+    def to_analysis(self):
+        """Reconstruct an unbatched ``FrameAnalysis`` (diagnostics-only
+        profile fields zeroed) -- what the warm-up parity gate and other
+        FrameAnalysis consumers read off dispatcher results."""
+        from robotic_discovery_platform_tpu.ops import geometry
+        from robotic_discovery_platform_tpu.ops import pipeline
+
+        coverage, mean_k, max_k, valid, margin = self.scalars()
+        zero = np.int32(0)
+        prof = geometry.CurvatureProfile(
+            mean_curvature=np.float32(mean_k),
+            max_curvature=np.float32(max_k),
+            spline_points=(self.spline() if valid
+                           else np.zeros((self.n_pts, 3), np.float32)),
+            valid=np.bool_(valid),
+            num_cloud_points=zero,
+            num_edge_points=zero,
+            truncated=np.bool_(False),
+        )
+        return pipeline.FrameAnalysis(
+            mask=self.unpack_mask(),
+            mask_coverage=np.float32(coverage),
+            profile=prof,
+            confidence_margin=np.float32(margin),
+        )
+
+    def release(self) -> None:
+        """Return this row's staging buffer share to the pool. Idempotent."""
+        if self._released:
+            return
+        self._released = True
+        release = self._release
+        self._release = None
+        if release is not None:
+            release()
+
+
+# -- wire codecs -------------------------------------------------------------
+
+
+def encode_bits_wire(bits: np.ndarray, h: int, w: int) -> bytes:
+    """``mask_format=1`` payload: 8-byte header + the bitpacked rows --
+    a straight ``tobytes()`` of the staging view, no transform."""
+    return _BITS_HEADER.pack(WIRE_BITS_MAGIC, h, w) + bits.tobytes()
+
+
+def mask_runs(mask: np.ndarray) -> np.ndarray:
+    """Row-major run lengths of a 0/1 mask, alternating and STARTING
+    with a zero run (a leading zero-length run when pixel (0, 0) is
+    set) -- the RLE wire convention."""
+    flat = np.asarray(mask, np.uint8).ravel()
+    if flat.size == 0:
+        return np.zeros(0, "<u4")
+    change = np.flatnonzero(flat[1:] != flat[:-1]) + 1
+    bounds = np.concatenate([[0], change, [flat.size]])
+    runs = np.diff(bounds).astype("<u4")
+    if flat[0]:
+        runs = np.concatenate([np.zeros(1, "<u4"), runs])
+    return runs
+
+
+def encode_rle_wire(mask: np.ndarray, h: int, w: int) -> bytes:
+    """``mask_format=2`` payload: 12-byte header + little-endian u32
+    run lengths (alternating zero/one runs, zero first)."""
+    runs = mask_runs(mask)
+    return (_RLE_HEADER.pack(WIRE_RLE_MAGIC, h, w, runs.size)
+            + runs.tobytes())
+
+
+def decode_mask_wire(data: bytes) -> np.ndarray | None:
+    """Decode a packed ``AnalysisResponse.mask`` payload back to the
+    exact [H, W] uint8 0/1 mask. Returns None when the payload is not a
+    packed format (i.e. legacy PNG bytes -- the caller's image decoder
+    owns those)."""
+    if len(data) >= _BITS_HEADER.size and data[:4] == WIRE_BITS_MAGIC:
+        _, h, w = _BITS_HEADER.unpack_from(data)
+        wb = (w + 7) // 8
+        bits = np.frombuffer(
+            data, np.uint8, count=h * wb, offset=_BITS_HEADER.size
+        ).reshape(h, wb)
+        return np.unpackbits(bits, axis=1)[:, :w]
+    if len(data) >= _RLE_HEADER.size and data[:4] == WIRE_RLE_MAGIC:
+        _, h, w, n_runs = _RLE_HEADER.unpack_from(data)
+        runs = np.frombuffer(
+            data, "<u4", count=n_runs, offset=_RLE_HEADER.size
+        )
+        if int(runs.sum()) != h * w:
+            raise ValueError(
+                f"RLE runs cover {int(runs.sum())} pixels; header says "
+                f"{h}x{w}"
+            )
+        values = (np.arange(n_runs, dtype=np.uint8) & 1)
+        return np.repeat(values, runs).reshape(h, w)
+    return None
+
+
+def decode_spline_wire(data: bytes) -> np.ndarray:
+    """``AnalysisResponse.packed_spline`` -> [N, 3] float32 (x, y, z)."""
+    return np.frombuffer(data, "<f4").reshape(-1, 3)
+
+
+# -- encode pool -------------------------------------------------------------
+
+
+@dataclass(eq=False)  # identity semantics: instances live in _pending sets
+class _PendingEncode:
+    """One encode job riding the pool queue."""
+
+    fmt: str  # "png" | "bits" | "rle"
+    mask: np.ndarray | None = None   # [H, W] uint8 0/1 (png, rle)
+    bits: np.ndarray | None = None   # [H, ceil(W/8)] uint8 (bits, rle)
+    shape: tuple[int, int] = (0, 0)  # (h, w) of the native mask
+    done: threading.Event = field(default_factory=threading.Event)
+    result: bytes | None = None
+    error: BaseException | None = None
+    queued_ns: int = field(default_factory=time.monotonic_ns)
+
+
+class EncodePool:
+    """Bounded pool of response-encode workers with the decode pool's
+    liveness guarantees (watchdog restart, error-completed stranded
+    frames, drain-safe ``stop``).
+
+    ``workers=0`` runs no threads at all: :meth:`encode` runs the encode
+    synchronously in the handler thread -- byte-for-byte the historical
+    path for PNG (the bitwise-parity mode every parity test pins).
+    """
+
+    def __init__(self, workers: int, *, watchdog_interval_s: float = 1.0,
+                 flight_recorder: recorder_lib.FlightRecorder | None = None):
+        self.workers = max(0, int(workers))
+        self._recorder = (flight_recorder if flight_recorder is not None
+                          else recorder_lib.RECORDER)
+        self._q: queue.Queue[_PendingEncode | None] = queue.Queue()
+        self._stopped = threading.Event()
+        self._submit_lock = checked_lock("egress.submit")
+        self._pending: set[_PendingEncode] = set()  # guarded_by: _pending_lock
+        self._pending_lock = checked_lock("egress.pending")
+        self.worker_restarts = 0
+        self._threads: list[threading.Thread] = []
+        self._watchdog: threading.Thread | None = None
+        if self.workers > 0:
+            self._threads = [self._start_worker(i)
+                             for i in range(self.workers)]
+            if watchdog_interval_s > 0:
+                self._watchdog = threading.Thread(
+                    target=self._watch, args=(watchdog_interval_s,),
+                    name="egress-watchdog", daemon=True,
+                )
+                self._watchdog.start()
+
+    def _start_worker(self, i: int) -> threading.Thread:
+        t = threading.Thread(target=self._worker_loop,
+                             name=f"egress-encode-{i}", daemon=True)
+        t.start()
+        return t
+
+    # -- encode core --------------------------------------------------------
+
+    def _encode_core(self, p: _PendingEncode) -> bytes:
+        """One guarded, timed encode (whichever thread runs it): the
+        ``serving.egress.encode`` fault site, ``rdp_encode_seconds``,
+        ``rdp_egress_bytes_total``, the host-split ``encode`` stage, and
+        one ``egress`` flight-recorder timeline."""
+        t0 = time.monotonic_ns()
+        inject(fault_sites.SERVING_EGRESS_ENCODE)
+        h, w = p.shape
+        if p.fmt == "png":
+            import cv2
+
+            # the legacy wire bytes exactly: 0/1 -> 0/255 then PNG
+            ok, buf = cv2.imencode(".png", p.mask * 255)
+            if not ok:
+                raise ValueError("mask encode failed")
+            result = buf.tobytes()
+        elif p.fmt == "bits":
+            result = encode_bits_wire(p.bits, h, w)
+        elif p.fmt == "rle":
+            mask = (p.mask if p.mask is not None
+                    else np.unpackbits(p.bits, axis=1)[:, :w])
+            result = encode_rle_wire(mask, h, w)
+        else:
+            raise ValueError(f"unknown egress encode format {p.fmt!r}")
+        t1 = time.monotonic_ns()
+        dt = (t1 - t0) / 1e9
+        obs.ENCODE_SECONDS.labels(format=p.fmt).observe(dt)
+        obs.HOST_STAGE_SPLIT.labels(stage="encode").observe(dt)
+        obs.EGRESS_BYTES.labels(format=p.fmt).inc(len(result))
+        tl = recorder_lib.Timeline("egress", labels={
+            "format": p.fmt,
+            "mode": "pool" if self.workers else "inline",
+        })
+        root = tl.span("egress", start_ns=t0, end_ns=t1)
+        tl.span("encode", start_ns=t0, end_ns=t1, parent=root)
+        self._recorder.record(tl)
+        return result
+
+    # -- caller side --------------------------------------------------------
+
+    def encode(self, fmt: str, *, mask: np.ndarray | None = None,
+               bits: np.ndarray | None = None,
+               shape: tuple[int, int] | None = None,
+               timeout_s: float | None = None) -> bytes:
+        """Encode one response mask payload, blocking until done.
+
+        ``fmt`` is "png" (input ``mask``), "bits" (input ``bits``), or
+        "rle" (input ``mask`` or ``bits``). ``shape`` is the native
+        (h, w); defaults to ``mask.shape``. Per-frame failures raise to
+        THIS caller only -- the workers never die on a bad frame."""
+        if shape is None:
+            shape = tuple(mask.shape[:2])
+        p = _PendingEncode(fmt, mask=mask, bits=bits, shape=shape)
+        if self.workers == 0:
+            self._run_one(p)
+        else:
+            with self._submit_lock:
+                if self._stopped.is_set():
+                    p.error = RuntimeError("encode pool stopped")
+                    p.done.set()
+                else:
+                    with self._pending_lock:
+                        self._pending.add(p)
+                    self._q.put(p)
+                    obs.EGRESS_QUEUE_DEPTH.set(self._q.qsize())
+            wait_s = timeout_s if timeout_s is not None else 60.0
+            if not p.done.wait(wait_s):
+                p.error = DeadlineExceeded(
+                    f"encode not ready within {wait_s:.2f}s"
+                )
+            with self._pending_lock:
+                self._pending.discard(p)
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    # -- worker side --------------------------------------------------------
+
+    def _run_one(self, p: _PendingEncode) -> None:
+        try:
+            p.result = self._encode_core(p)
+        except BaseException as exc:  # deliver, don't kill the worker
+            p.error = exc
+        finally:
+            p.done.set()
+            with self._pending_lock:
+                self._pending.discard(p)
+
+    def _worker_loop(self) -> None:
+        while True:
+            p = self._q.get()
+            obs.EGRESS_QUEUE_DEPTH.set(self._q.qsize())
+            if p is None:
+                return
+            # deliberately OUTSIDE the per-frame guard: an injected fault
+            # here kills the worker thread itself -- the watchdog drill
+            inject(fault_sites.SERVING_EGRESS_LOOP)
+            self._run_one(p)
+
+    # -- watchdog -----------------------------------------------------------
+
+    def _watch(self, interval_s: float) -> None:
+        """Mirror of the decode pool's watchdog: a worker that died
+        outside its per-frame guard is restarted, and every pending
+        frame is error-completed NOW -- no handler waits out its full
+        deadline against a threadless pool."""
+        while not self._stopped.wait(interval_s):
+            dead = [i for i, t in enumerate(self._threads)
+                    if not t.is_alive()]
+            if not dead:
+                continue
+            with self._submit_lock:
+                if self._stopped.is_set():
+                    return
+                self.worker_restarts += len(dead)
+                obs.WATCHDOG_RESTARTS.inc()
+                self._recorder.record_event(
+                    "watchdog_restart", stage="egress",
+                    error=f"{len(dead)} encode worker(s) died; "
+                          f"{len(self._pending)} pending frame(s) failed",
+                )
+                journal_lib.JOURNAL.append(
+                    events.WATCHDOG_RESTART, stage="egress",
+                    workers=len(dead), pending=len(self._pending),
+                )
+                log.error(
+                    "%d encode worker(s) died unexpectedly; failing %d "
+                    "pending frame(s) and restarting (restart #%d)",
+                    len(dead), len(self._pending), self.worker_restarts,
+                )
+                while True:
+                    try:
+                        self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                obs.EGRESS_QUEUE_DEPTH.set(0)
+                self._fail_pending(RuntimeError(
+                    "encode worker died; frame dropped"
+                ))
+                for i in dead:
+                    self._threads[i] = self._start_worker(i)
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        with self._pending_lock:
+            stranded = [p for p in self._pending if not p.done.is_set()]
+            self._pending.clear()
+        for p in stranded:
+            p.error = exc
+            p.done.set()
+
+    def stop(self) -> None:
+        """Idempotent. Every pending encode gets a terminal outcome."""
+        with self._submit_lock:
+            self._stopped.set()
+            for _ in self._threads:
+                self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
+        while True:
+            try:
+                p = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if p is not None and not p.done.is_set():
+                p.error = RuntimeError("encode pool stopped")
+                p.done.set()
+        self._fail_pending(RuntimeError("encode pool stopped"))

@@ -377,6 +377,10 @@ class IngestFrame:
     #: the request's zoo model selector ("" = default model) -- read off
     #: the wire before decode, so even an errored frame is attributed
     model: str = ""
+    #: the request's response mask encoding (AnalysisRequest.mask_format:
+    #: 0 = legacy PNG, 1 = packed bits, 2 = RLE) -- read off the wire
+    #: alongside ``model`` so the egress side never re-touches the proto
+    mask_format: int = 0
 
 
 class DecodePool:
@@ -628,7 +632,8 @@ class DecodePool:
                 p = self.submit(request)
                 yield IngestFrame(p.rgb, p.depth, p.error, remaining,
                                   time.perf_counter() - t0, p.fmt,
-                                  model=request.model)
+                                  model=request.model,
+                                  mask_format=request.mask_format)
             return
         yield from self._iter_pooled(request_iterator, active,
                                      time_remaining)
@@ -691,7 +696,8 @@ class DecodePool:
                 self.wait(p, remaining if remaining is not None else 60.0)
                 yield IngestFrame(p.rgb, p.depth, p.error, remaining,
                                   time.perf_counter() - t0, p.fmt,
-                                  model=p.request.model)
+                                  model=p.request.model,
+                                  mask_format=p.request.mask_format)
         finally:
             stream_done.set()
             # best-effort join; a pump blocked in the gRPC iterator read

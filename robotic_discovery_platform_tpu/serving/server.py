@@ -144,6 +144,7 @@ from robotic_discovery_platform_tpu.resilience import (
 )
 from robotic_discovery_platform_tpu.serving import (
     controller as controller_lib,
+    egress as egress_lib,
     entropy as entropy_lib,
     fleet as fleet_lib,
     health as health_lib,
@@ -257,6 +258,9 @@ class _FrameResult(NamedTuple):
     mean_k: float
     max_k: float
     spline: np.ndarray
+    #: the response ``mask`` payload in the REQUESTED wire format
+    #: (mask_format 0 = legacy PNG bytes, 1 = packed bits, 2 = RLE);
+    #: empty when egress was skipped for a dead stream
     mask_png: bytes
     coverage: float
     valid: bool
@@ -265,6 +269,10 @@ class _FrameResult(NamedTuple):
     #: the aux head's defect/anomaly score (None for "segment" heads --
     #: i.e. always None on the default model's bitwise path)
     anomaly: float | None = None
+    #: the packed-spline response payload (f32 LE triples) for packed
+    #: wire formats; b"" on the legacy path, so the response field
+    #: serializes to zero bytes and legacy responses stay bitwise
+    spline_wire: bytes = b""
 
 
 class Engine(NamedTuple):
@@ -311,6 +319,15 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         if self.ingest.onchip:
             log.info("on-chip split decode: host entropy-decodes baseline "
                      "JPEG; dequant+IDCT+upsample+color ride the device")
+        # Host-path egress (serving/egress.py): the encode worker pool
+        # (0 workers = inline encode in the handler thread, the
+        # bitwise-parity mode) that takes legacy PNG encode -- and the
+        # packed/RLE wire encodes -- off the stream-handler hot path.
+        self.egress = egress_lib.EncodePool(
+            egress_lib.resolve_egress_workers(cfg.egress_workers)
+        )
+        if self.egress.workers:
+            log.info("egress encode pool: %d worker(s)", self.egress.workers)
         # direct-path (unbatched) decode+analyze graphs for
         # coefficient-lane frames, memoized per (h, w, subsampling);
         # rebuilt lazily after every engine swap (_make_engine clears it)
@@ -804,7 +821,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             coef_analyze = pipeline.make_coef_batch_analyzer(
                 _model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
                 forward=_forward, height=height, width=width,
-                subsampling=subsampling,
+                subsampling=subsampling, pack=cfg.egress_pack,
             )
             return (lambda y, cb, cr, qy, qc, depths, intr, scales:
                     coef_analyze(_variables, y, cb, cr, qy, qc, depths,
@@ -829,9 +846,13 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 make_batched = pipeline.make_scan_batch_analyzer
             else:
                 raise ValueError(f"unknown batch_impl {cfg.batch_impl!r}")
+            # egress_pack: the analyzer graph ends in the fused egress pack
+            # stage (ops/pipeline.pack_analysis), so the completer's D2H
+            # is ONE [B, P] uint8 fetch per dispatch and dispatcher
+            # results are serving/egress.PackedResult rows
             batch_analyze = make_batched(
                 model, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
-                forward=forward,
+                forward=forward, pack=cfg.egress_pack,
             )
             router = None
             if self._serving_mesh is not None:
@@ -1023,6 +1044,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                             else pipeline.make_scan_batch_analyzer)
             batched = make_batched(
                 model_q, img_size=cfg.model_img_size, geom_cfg=geom_cfg,
+                pack=cfg.egress_pack,
             )
             batch_analyze = (
                 lambda frames, depths, intr, scales,
@@ -1152,11 +1174,12 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
     def _analyze_frame(self, rgb: np.ndarray, depth: np.ndarray,
                        timer: StageTimer | None = None,
                        timeout_s: float | None = None,
-                       model: str = ""):
-        import cv2
-
+                       model: str = "",
+                       mask_format: int = 0,
+                       active=None):
         inject(fault_sites.SERVING_ANALYZE)
         timer = timer or StageTimer()
+        t_entry = time.monotonic()
         # split-decode frames carry coefficients, not pixels: the device
         # decodes them fused ahead of the analyzer (CoefficientFrame's
         # .shape property keeps every geometry read below uniform)
@@ -1206,39 +1229,105 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                 else:
                     out = eng.analyze(eng.variables, *frames_dev, k_dev,
                                       scale_dev)
-            # host fetch of the fused result
-            mask = np.asarray(out.mask)
-            coverage = float(out.mask_coverage)
-            prof = out.profile
-            valid = bool(prof.valid)
-            mean_k = float(prof.mean_curvature) if valid else 0.0
-            max_k = float(prof.max_curvature) if valid else 0.0
-            spline = (np.asarray(prof.spline_points) if valid
-                      else np.zeros((0, 3)))
-            # drift signals the frame already paid for: the margin rides
-            # the fused graph's result fetch, the depth-validity fraction
-            # is one host-side count over the raw depth frame
-            margin = float(np.asarray(out.confidence_margin))
+            if isinstance(out, jax.Array):
+                # the direct coefficient path under packing hands back a
+                # bare [P] uint8 payload row (its own single fetch)
+                out = egress_lib.PackedResult(np.asarray(out))
+            packed = out if isinstance(out, egress_lib.PackedResult) else None
+            if packed is not None:
+                # packed egress: the scalars ride the f32 sidecar of the
+                # completer's single per-dispatch fetch -- bitwise the
+                # values the legacy per-leaf fetches carried; the
+                # full-resolution mask only unpacks when something
+                # actually needs pixels
+                mask = None
+                coverage, mean_k, max_k, valid, margin = packed.scalars()
+                spline = (packed.spline() if not mask_format
+                          else np.zeros((0, 3), np.float32))
+            else:
+                # host fetch of the fused result (direct pixel path)
+                mask = np.asarray(out.mask)
+                coverage = float(out.mask_coverage)
+                prof = out.profile
+                valid = bool(prof.valid)
+                mean_k = float(prof.mean_curvature) if valid else 0.0
+                max_k = float(prof.max_curvature) if valid else 0.0
+                spline = (np.asarray(prof.spline_points) if valid
+                          else np.zeros((0, 3)))
+                margin = float(np.asarray(out.confidence_margin))
+            # drift signal the frame already paid for: the depth-validity
+            # fraction is one host-side count over the raw depth frame
             depth_valid = float(np.count_nonzero(depth)) / max(depth.size, 1)
-        with timer.stage("encode"):
-            ok, mask_png = cv2.imencode(".png", mask * 255)
-        if not ok:
-            raise ValueError("mask encode failed")
-        anomaly = None
-        if entry is not None and entry.variant.head == "anomaly":
-            # the aux head's product: defect/anomaly score off the
-            # confidence margin the fused graph already computed
-            anomaly = variants_lib.anomaly_score(margin)
-            obs.MODEL_ANOMALY_SCORE.observe(anomaly)
-        res = _FrameResult(mean_k, max_k, spline, mask_png.tobytes(),
-                           coverage, valid, margin, depth_valid, anomaly)
-        if entry is None and not coef:
-            # only default-model frames mirror to a rollout shadow: the
-            # shadow diff gates the DEFAULT generation's replacement --
-            # and only pixel frames can (a split-decode frame's RGB never
-            # materializes on the host, which is its point)
-            self._mirror_shadow(rgb, depth, geom.k_f32, mask, res)
-        return res
+        try:
+            spline_wire = b""
+            if mask_format:
+                # packed wire formats skip the per-point Point3D loop:
+                # the spline rides packed_spline as f32 LE triples
+                spline_wire = (packed.spline_wire() if packed is not None
+                               else np.ascontiguousarray(
+                                   spline, dtype="<f4").tobytes())
+                spline = np.zeros((0, 3), np.float32)
+            # bugfix (ISSUE 20): a frame whose stream is already cancelled
+            # or whose deadline expired while it rode the device must not
+            # pay encode cost (PNG + the mask*255 full-frame allocation)
+            # for an answer nobody will receive
+            dead = ((active is not None and not active())
+                    or (timeout_s is not None
+                        and time.monotonic() - t_entry >= timeout_s))
+            with timer.stage("encode"):
+                if dead:
+                    mask_bytes = b""
+                elif mask_format == egress_lib.MASK_FORMAT_BITS:
+                    # zero-transform: the wire payload IS the packed
+                    # staging rows behind a small header
+                    bits = (packed.mask_bits if packed is not None
+                            else np.packbits(mask, axis=-1))
+                    shape = ((packed.h, packed.w) if packed is not None
+                             else mask.shape[:2])
+                    mask_bytes = self.egress.encode(
+                        "bits", bits=bits, shape=shape, timeout_s=timeout_s
+                    )
+                elif mask_format == egress_lib.MASK_FORMAT_RLE:
+                    mask_bytes = self.egress.encode(
+                        "rle", mask=mask,
+                        bits=packed.mask_bits if packed is not None else None,
+                        shape=((packed.h, packed.w) if packed is not None
+                               else mask.shape[:2]),
+                        timeout_s=timeout_s,
+                    )
+                else:
+                    # legacy PNG (and any unknown mask_format): the
+                    # historical wire bytes exactly
+                    m = packed.unpack_mask() if packed is not None else mask
+                    mask_bytes = self.egress.encode(
+                        "png", mask=m, timeout_s=timeout_s
+                    )
+            anomaly = None
+            if entry is not None and entry.variant.head == "anomaly":
+                # the aux head's product: defect/anomaly score off the
+                # confidence margin the fused graph already computed
+                anomaly = variants_lib.anomaly_score(margin)
+                obs.MODEL_ANOMALY_SCORE.observe(anomaly)
+            res = _FrameResult(mean_k, max_k, spline, mask_bytes,
+                               coverage, valid, margin, depth_valid,
+                               anomaly, spline_wire)
+            if (entry is None and not coef
+                    and self._shadow_hook is not None):
+                # only default-model frames mirror to a rollout shadow:
+                # the shadow diff gates the DEFAULT generation's
+                # replacement -- and only pixel frames can (a split-decode
+                # frame's RGB never materializes on the host, which is its
+                # point). Checked here so a packed frame only unpacks its
+                # mask when a shadow tap is actually installed.
+                if mask is None:
+                    mask = packed.unpack_mask()
+                self._mirror_shadow(rgb, depth, geom.k_f32, mask, res)
+            return res
+        finally:
+            # hand the packed row's share of the pooled staging buffer
+            # back to the dispatcher (everything needed was copied out)
+            if packed is not None:
+                packed.release()
 
     def _analyze_coef_direct(self, frame, depth, geom, entry):
         """Direct-path (unbatched) ride for a coefficient-lane frame: the
@@ -1453,14 +1542,12 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
             # exported histogram and the log summary observe the same
             # measurements)
             def _observe_stage(stage: str, dt: float) -> None:
+                # the host-split decode AND encode samples are observed by
+                # the ingest/egress pools themselves (actual work wherever
+                # it ran); the handler-side numbers here are just the WAIT
+                # when a pool ran the stage off-thread
                 obs.STAGE_LATENCY.labels(stage=stage).observe(dt)
                 obs.STAGE_LATENCY_SUMMARY.labels(stage=stage).observe(dt)
-                if stage == "encode":
-                    # encode is handler-thread host work; decode's split
-                    # sample is observed by the ingest pool itself (the
-                    # handler-side number here is just the WAIT when the
-                    # pool decoded it off-thread)
-                    obs.HOST_STAGE_SPLIT.labels(stage=stage).observe(dt)
 
             timer = StageTimer(observer=_observe_stage)
             # ingest iterator: cancellation + client-deadline checks, and
@@ -1487,7 +1574,9 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                     label, entry = self._resolve_model(inf.model)
                     res = self._analyze_frame(inf.rgb, inf.depth, timer,
                                               timeout_s=remaining,
-                                              model=inf.model)
+                                              model=inf.model,
+                                              mask_format=inf.mask_format,
+                                              active=context.is_active)
                     status = ("OK" if res.valid
                               else "DEGRADED: insufficient geometry")
                     if res.anomaly is not None:
@@ -1496,6 +1585,11 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                         # ERROR prefixes), and only ever present on
                         # frames that explicitly asked for this model
                         status += f" anomaly={res.anomaly:.4f}"
+                    # packed wire formats carry the spline as
+                    # packed_spline bytes and res.spline is empty (the
+                    # per-point Point3D loop runs zero times); on the
+                    # legacy path spline_wire is b"" and serializes to
+                    # zero bytes -- pre-PR responses stay bitwise
                     response = vision_pb2.AnalysisResponse(
                         mean_curvature=res.mean_k,
                         max_curvature=res.max_k,
@@ -1506,6 +1600,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
                         status=status,
                         mask=res.mask_png,
                         mask_coverage=res.coverage,
+                        packed_spline=res.spline_wire,
                     )
                     self.metrics.append(res.mean_k, res.max_k, res.coverage)
                     self._observe_drift(res, entry)
@@ -1962,8 +2057,16 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         ):
             refs.append(ref_analyze(ref_variables, rgb, depth, k, scale))
             if got_path is None:
-                gots.append(eng.dispatcher.submit(
-                    rgb, depth, k, float(scale), model=submit_model))
+                got = eng.dispatcher.submit(
+                    rgb, depth, k, float(scale), model=submit_model)
+                if isinstance(got, egress_lib.PackedResult):
+                    # the packed serving path: reconstruct the
+                    # FrameAnalysis view the parity report reads (mask +
+                    # scalars are exact through the pack/unpack pair)
+                    analysis = got.to_analysis()
+                    got.release()
+                    got = analysis
+                gots.append(got)
             else:
                 analyze, variables = got_path
                 gots.append(analyze(variables, rgb, depth, k, scale))
@@ -2068,6 +2171,7 @@ class VisionAnalysisService(vision_grpc.VisionAnalysisServiceServicer):
         if engine.dispatcher is not None:
             engine.dispatcher.stop()
         self.ingest.stop()
+        self.egress.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None

@@ -268,6 +268,21 @@ class ServerConfig:
     # (bounds per-stream decoded-frame memory; only meaningful with
     # decode_workers > 0).
     ingest_prefetch: int = 2
+    # Host-path egress (serving/egress.py): encode worker pool width.
+    # 0 (default) encodes response masks inline in the handler thread --
+    # byte-for-byte the historical path, the bitwise-parity serial mode.
+    # N > 0 moves legacy PNG encode (cv2 releases the GIL) and the
+    # packed/RLE wire encodes onto N pool threads so the handler is free
+    # to pump the next frame while this one's response is encoded.
+    # Negative = one worker per CPU. The RDP_EGRESS_WORKERS env var
+    # overrides this value.
+    egress_workers: int = 0
+    # When True (default), the batch analyzers end in the fused device
+    # pack stage (ops/pipeline.pack_analysis): one [B, P] uint8 D2H per
+    # dispatch, results parsed by serving/egress.PackedResult. False
+    # restores the pre-pack FrameAnalysis fetch -- the "before" leg of
+    # bench_load.py --host-profile's egress comparison.
+    egress_pack: bool = True
     # Split JPEG decode (serving/entropy.py + ops/pallas/decode.py):
     # when True, baseline-JPEG color payloads are entropy-decoded on the
     # host to quantized coefficient blocks and the pixel half (dequant +

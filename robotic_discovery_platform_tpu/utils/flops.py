@@ -195,6 +195,20 @@ def jpeg_decode_roofline_ms(h: int, w: int, batch: int = 1,
     )
 
 
+def mask_bitpack_roofline_ms(h: int, w: int, batch: int = 1) -> dict:
+    """Roofline for the egress mask bitpack (ops/pallas/pack.bitpack_mask):
+    ~2 integer VPU ops per input pixel (the nonzero test and one
+    shift-accumulate step of the unrolled 8-way reduction), against
+    reading the [B, H, W] uint8 mask once and writing the 8x-smaller
+    [B, H, ceil(W/8)] packed bytes once. At ~2 FLOP per ~1.1 bytes the
+    launch is bandwidth-bound by construction -- one HBM pass over the
+    mask, which is the point: packing must ride free under the analyzer,
+    and the D2H payload it buys shrinks 8x (bench_pallas.py asserts the
+    bound class)."""
+    px = batch * h * w
+    return roofline_ms(2 * px, px + batch * h * ((w + 7) // 8))
+
+
 def unet_forward_flops(img_size: int = 256, base: int = 64,
                        in_ch: int = 3, num_classes: int = 1,
                        bilinear: bool = True) -> int:

@@ -1,0 +1,614 @@
+"""Host-path egress suite (serving/egress.py, ops/pallas/pack.py):
+device bitpack parity, packed-payload roundtrip, wire codecs, encode-pool
+parity and liveness, and the completer's one-fetch-per-dispatch contract.
+
+Runs clean under RDP_LOCKCHECK=strict / RDP_TRANSFER_GUARD=strict (the CI
+egress-smoke job does exactly that)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from oracle import make_arc_scene
+
+from robotic_discovery_platform_tpu.observability import instruments as obs
+from robotic_discovery_platform_tpu.ops import pipeline
+from robotic_discovery_platform_tpu.ops.pallas import pack
+from robotic_discovery_platform_tpu.resilience import configure_faults
+from robotic_discovery_platform_tpu.serving import client as client_lib
+from robotic_discovery_platform_tpu.serving import egress
+from robotic_discovery_platform_tpu.utils.config import GeometryConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    configure_faults(None)
+    yield
+    configure_faults(None)
+
+
+def _random_mask(h, w, seed=0, p=0.5):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < p).astype(np.uint8)
+
+
+# -- device bitpack ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("w", [8, 9, 24, 61, 64])
+def test_bitpack_matches_np_packbits(w):
+    """The device pack is np.packbits bit-for-bit (MSB first), including
+    ragged widths that pad the last byte, so np.unpackbits is the exact
+    host-side inverse."""
+    mask = np.stack([_random_mask(16, w, seed=s) for s in range(3)])
+    got = np.asarray(pack.bitpack_mask(jnp.asarray(mask), impl="xla"))
+    want = np.packbits(mask, axis=-1)
+    np.testing.assert_array_equal(got, want)
+    back = np.unpackbits(got, axis=-1)[..., :w]
+    np.testing.assert_array_equal(back, mask)
+
+
+def test_bitpack_xla_vs_interpret_cotraced_bitwise():
+    """Both backends co-traced in ONE jit graph produce identical bytes
+    (the shared _pack_math arithmetic): the pallas kernel body is the XLA
+    fallback, not an approximation of it."""
+
+    @jax.jit
+    def both(m):
+        return (pack.bitpack_mask(m, impl="xla"),
+                pack.bitpack_mask(m, impl="interpret"))
+
+    for mask in (
+        np.stack([_random_mask(32, 40, seed=7)] * 2),
+        np.zeros((1, 16, 24), np.uint8),          # all-zero
+        np.ones((1, 16, 24), np.uint8),           # all-one
+        np.ones((2, 8, 13), np.uint8) * 255,      # nonzero-but-not-1, odd w
+    ):
+        a, b = both(jnp.asarray(mask))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            np.asarray(a), np.packbits(mask, axis=-1)
+        )
+
+
+def test_payload_row_geometry():
+    assert pack.packed_row_bytes(64) == 8
+    assert pack.packed_row_bytes(61) == 8
+    # header + sidecar + mask rows, padded to a 64-byte multiple
+    n = pack.frame_payload_bytes(16, 61, 5)
+    assert n % pack.ROW_ALIGN == 0
+    assert n >= pack.HEADER_BYTES + 4 * pack.sidecar_floats(5) + 16 * 8
+    hdr = pack.payload_header(16, 61, 5)
+    assert hdr.shape == (pack.HEADER_BYTES,)
+    assert bytes(hdr[:4]) == pack.ROW_MAGIC
+
+
+# -- wire codecs -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("h,w", [(16, 64), (13, 61), (1, 8), (5, 9)])
+def test_bits_wire_roundtrip_exact(h, w):
+    mask = _random_mask(h, w, seed=h * w)
+    bits = np.packbits(mask, axis=-1)
+    data = egress.encode_bits_wire(bits, h, w)
+    assert data[:4] == egress.WIRE_BITS_MAGIC
+    back = egress.decode_mask_wire(data)
+    np.testing.assert_array_equal(back, mask)
+
+
+@pytest.mark.parametrize("mask", [
+    _random_mask(16, 64, seed=1),
+    _random_mask(13, 61, seed=2, p=0.05),       # smooth-ish, long runs
+    np.zeros((8, 24), np.uint8),                # all-zero
+    np.ones((8, 24), np.uint8),                 # all-one (leading 0-run)
+    np.eye(16, dtype=np.uint8),                 # pixel (0, 0) set
+])
+def test_rle_wire_roundtrip_exact(mask):
+    h, w = mask.shape
+    data = egress.encode_rle_wire(mask, h, w)
+    assert data[:4] == egress.WIRE_RLE_MAGIC
+    back = egress.decode_mask_wire(data)
+    np.testing.assert_array_equal(back, mask)
+    # the convention: runs alternate starting with a ZERO run
+    runs = egress.mask_runs(mask)
+    assert int(runs.sum()) == h * w
+    if mask.ravel()[0]:
+        assert runs[0] == 0
+
+
+def test_decode_mask_wire_ignores_png():
+    """Legacy PNG payloads are not ours to decode: the caller's image
+    decoder owns them (PNG's \\x89PNG signature can never collide with
+    the packed magics)."""
+    import cv2
+
+    ok, buf = cv2.imencode(".png", _random_mask(8, 8) * 255)
+    assert ok
+    assert egress.decode_mask_wire(buf.tobytes()) is None
+    assert egress.decode_mask_wire(b"") is None
+
+
+def test_decode_rle_rejects_mismatched_pixel_count():
+    data = egress._RLE_HEADER.pack(egress.WIRE_RLE_MAGIC, 4, 4, 1) + \
+        np.array([7], "<u4").tobytes()
+    with pytest.raises(ValueError, match="RLE runs cover"):
+        egress.decode_mask_wire(data)
+
+
+def test_spline_wire_roundtrip():
+    spline = np.arange(15, dtype=np.float32).reshape(5, 3)
+    data = np.ascontiguousarray(spline, dtype="<f4").tobytes()
+    np.testing.assert_array_equal(egress.decode_spline_wire(data), spline)
+    assert egress.decode_spline_wire(b"").shape == (0, 3)
+
+
+def test_mask_format_names():
+    assert egress.mask_format_name(0) == "png"
+    assert egress.mask_format_name(1) == "bits"
+    assert egress.mask_format_name(2) == "rle"
+    assert egress.mask_format_name(9) == "unknown"
+
+
+# -- packed analysis rows ----------------------------------------------------
+
+
+def test_pack_analysis_roundtrips_legacy_leaves_bitwise():
+    """pack=True vs pack=False on the SAME model and frames: every value
+    the response needs comes back off the packed row exactly as the
+    legacy per-leaf fetches reported it -- including the invalid frame's
+    0.0 curvature (the jnp.where NaN guard)."""
+    from robotic_discovery_platform_tpu.models.unet import UNet
+
+    model = UNet(base_features=8, dtype=jnp.float32)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False
+    )
+    mask, depth, k, scale, _ = make_arc_scene(h=120, w=160, r_px=70.0,
+                                              band_px=30)
+    frame = np.dstack([mask * 200] * 3).astype(np.uint8)
+    frames = jnp.stack([jnp.asarray(frame),
+                        jnp.zeros_like(jnp.asarray(frame))])
+    depths = jnp.stack([jnp.asarray(depth),
+                        jnp.zeros_like(jnp.asarray(depth))])
+    ks = jnp.stack([jnp.asarray(k, jnp.float32)] * 2)
+    scales = jnp.full((2,), scale, jnp.float32)
+
+    legacy_fn = pipeline.make_batch_analyzer(model, img_size=64)
+    packed_fn = pipeline.make_batch_analyzer(model, img_size=64, pack=True)
+    legacy = jax.tree.map(np.asarray,
+                          legacy_fn(variables, frames, depths, ks, scales))
+    rows = np.asarray(packed_fn(variables, frames, depths, ks, scales))
+
+    n_pts = GeometryConfig().num_samples
+    assert rows.shape == (2, pack.frame_payload_bytes(120, 160, n_pts))
+    for i in range(2):
+        pr = egress.PackedResult(rows[i])
+        assert (pr.h, pr.w, pr.n_pts) == (120, 160, n_pts)
+        coverage, mean_k, max_k, valid, margin = pr.scalars()
+        assert valid == bool(legacy.profile.valid[i])
+        assert coverage == float(legacy.mask_coverage[i])
+        assert margin == float(legacy.confidence_margin[i])
+        # the legacy host convention: invalid frames report 0.0 curvature
+        want_mean = float(legacy.profile.mean_curvature[i]) if valid else 0.0
+        want_max = float(legacy.profile.max_curvature[i]) if valid else 0.0
+        assert mean_k == want_mean and max_k == want_max
+        np.testing.assert_array_equal(pr.unpack_mask(), legacy.mask[i])
+        if valid:
+            np.testing.assert_array_equal(
+                pr.spline(),
+                np.asarray(legacy.profile.spline_points[i], np.float32),
+            )
+            assert pr.spline_wire() == pr.spline().tobytes()
+        else:
+            assert pr.spline().shape == (0, 3)
+            assert pr.spline_wire() == b""
+        # to_analysis reconstructs the FrameAnalysis consumers read
+        fa = pr.to_analysis()
+        np.testing.assert_array_equal(fa.mask, legacy.mask[i])
+        assert float(fa.mask_coverage) == coverage
+        assert bool(fa.profile.valid) == valid
+
+
+def test_packed_result_validates_header():
+    with pytest.raises(ValueError, match="1-D uint8"):
+        egress.PackedResult(np.zeros((2, 64), np.uint8))
+    bad = np.zeros(pack.frame_payload_bytes(4, 8, 2), np.uint8)
+    with pytest.raises(ValueError, match="magic"):
+        egress.PackedResult(bad)
+    short = np.zeros(pack.HEADER_BYTES, np.uint8)
+    short[:pack.HEADER_BYTES] = pack.payload_header(4, 8, 2)
+    with pytest.raises(ValueError, match="bytes"):
+        egress.PackedResult(short)
+
+
+def test_packed_result_release_idempotent():
+    calls = []
+    row = np.zeros(pack.frame_payload_bytes(4, 8, 2), np.uint8)
+    row[:pack.HEADER_BYTES] = pack.payload_header(4, 8, 2)
+    pr = egress.PackedResult(row, release=lambda: calls.append(1))
+    pr.release()
+    pr.release()
+    assert calls == [1]
+
+
+# -- encode pool -------------------------------------------------------------
+
+
+def test_encode_pool_parity_inline_vs_workers():
+    """workers=0 and workers=N produce byte-identical payloads for every
+    format on the same masks."""
+    inline = egress.EncodePool(0)
+    pooled = egress.EncodePool(3)
+    try:
+        for seed in range(4):
+            mask = _random_mask(32, 40, seed=seed)
+            bits = np.packbits(mask, axis=-1)
+            for fmt, kw in (
+                ("png", dict(mask=mask)),
+                ("bits", dict(bits=bits, shape=(32, 40))),
+                ("rle", dict(mask=mask)),
+                ("rle", dict(bits=bits, shape=(32, 40))),
+            ):
+                a = inline.encode(fmt, **kw)
+                b = pooled.encode(fmt, **kw)
+                assert a == b
+    finally:
+        inline.stop()
+        pooled.stop()
+
+
+def test_encode_pool_inline_png_is_legacy_bytes():
+    """workers=0 PNG encode is byte-for-byte the historical inline
+    cv2.imencode(mask * 255) -- the serial bitwise-parity mode."""
+    import cv2
+
+    mask = _random_mask(24, 24, seed=5)
+    pool = egress.EncodePool(0)
+    try:
+        got = pool.encode("png", mask=mask)
+    finally:
+        pool.stop()
+    ok, buf = cv2.imencode(".png", mask * 255)
+    assert ok and got == buf.tobytes()
+
+
+def test_encode_records_metrics():
+    mask = _random_mask(16, 16, seed=6)
+    pool = egress.EncodePool(0)
+    before_n = obs.ENCODE_SECONDS.labels(format="png").count
+    before_b = obs.EGRESS_BYTES.labels(format="png").value
+    try:
+        data = pool.encode("png", mask=mask)
+    finally:
+        pool.stop()
+    assert obs.ENCODE_SECONDS.labels(format="png").count == before_n + 1
+    assert obs.EGRESS_BYTES.labels(format="png").value == \
+        before_b + len(data)
+
+
+def test_encode_fault_errors_frame_not_worker():
+    """serving.egress.encode fires inside the per-frame guard: the frame
+    errors to ITS caller, the worker survives, later frames encode."""
+    configure_faults("serving.egress.encode:exc:1")
+    pool = egress.EncodePool(1)
+    mask = _random_mask(16, 16, seed=7)
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            pool.encode("png", mask=mask)
+        assert pool.encode("png", mask=mask)  # worker still serving
+        assert all(t.is_alive() for t in pool._threads)
+    finally:
+        pool.stop()
+
+
+def test_worker_death_watchdog_restart_zero_lost_frames():
+    """serving.egress.loop kills a worker OUTSIDE the per-frame guard:
+    the watchdog restarts it, every in-flight frame gets a terminal
+    outcome (error, never a hang), and the restarted pool keeps
+    serving."""
+    configure_faults("serving.egress.loop:exc:1")
+    pool = egress.EncodePool(1, watchdog_interval_s=0.05)
+    mask = _random_mask(16, 16, seed=8)
+    try:
+        with pytest.raises(Exception):  # terminal outcome, not a hang
+            pool.encode("png", mask=mask, timeout_s=10.0)
+        deadline = time.monotonic() + 10.0
+        while pool.worker_restarts == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pool.worker_restarts >= 1
+        # restarted pool serves: zero frames lost going forward
+        assert pool.encode("png", mask=mask, timeout_s=10.0)
+    finally:
+        pool.stop()
+
+
+def test_encode_pool_stop_strands_nothing():
+    pool = egress.EncodePool(2)
+    pool.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        pool.encode("png", mask=_random_mask(8, 8))
+
+
+def test_resolve_egress_workers(monkeypatch):
+    monkeypatch.delenv("RDP_EGRESS_WORKERS", raising=False)
+    assert egress.resolve_egress_workers(0) == 0
+    assert egress.resolve_egress_workers(3) == 3
+    assert egress.resolve_egress_workers(-1) >= 1
+    monkeypatch.setenv("RDP_EGRESS_WORKERS", "5")
+    assert egress.resolve_egress_workers(0) == 5
+
+
+# -- one fetch per dispatch --------------------------------------------------
+
+
+_N_PTS = 4
+
+
+def _packed_rows(b, h, w):
+    """Hand-built [B, P] packed payload rows (the pack_analysis layout)."""
+    rows = np.zeros((b, pack.frame_payload_bytes(h, w, _N_PTS)), np.uint8)
+    for i in range(b):
+        side = np.zeros(pack.sidecar_floats(_N_PTS), np.float32)
+        side[:pack.N_SCALARS] = [10.0 + i, 0.5, 1.0, 1.0, 0.25]
+        side[pack.N_SCALARS:] = np.arange(3 * _N_PTS, dtype=np.float32) + i
+        mask = ((np.arange(h * w).reshape(h, w) + i) % 2).astype(np.uint8)
+        row = np.concatenate([
+            pack.payload_header(h, w, _N_PTS),
+            side.view(np.uint8),
+            np.packbits(mask, axis=-1).ravel(),
+        ])
+        rows[i, :row.size] = row
+    return rows
+
+
+def test_completer_one_fetch_per_dispatch_pooled_staging():
+    """A packed dispatch is ONE D2H fetch: every frame of the batch gets
+    a zero-copy row view into the SAME pooled staging buffer, the host
+    split records exactly one d2h sample for the dispatch, and the last
+    release returns the buffer to the dispatcher's egress pool."""
+    from robotic_discovery_platform_tpu.serving.batching import (
+        BatchDispatcher,
+    )
+
+    def analyze(frames, depths, intr, scales):
+        return jnp.asarray(_packed_rows(len(frames), 8, 8))
+
+    d = BatchDispatcher(analyze, window_ms=150.0, max_batch=4)
+    frame = np.zeros((8, 8, 3), np.uint8)
+    depth = np.zeros((8, 8), np.uint16)
+    k = np.eye(3, dtype=np.float32)
+    before = obs.HOST_STAGE_SPLIT.labels(stage="d2h").count
+
+    results = [None] * 3
+
+    def submit_one(i):
+        results[i] = d.submit(frame, depth, k, 0.001)
+
+    threads = [threading.Thread(target=submit_one, args=(i,))
+               for i in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(isinstance(r, egress.PackedResult) for r in results)
+        # one dispatch, one fetch: all three rows view one staging buffer
+        bases = {id(r.payload.base) for r in results}
+        assert len(bases) == 1
+        # the completer observes the d2h sample after waking the
+        # submitters (its finally block): poll briefly for it
+        deadline = time.monotonic() + 5.0
+        while (obs.HOST_STAGE_SPLIT.labels(stage="d2h").count == before
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert obs.HOST_STAGE_SPLIT.labels(stage="d2h").count == before + 1
+        # rows are per-frame: the sidecar scalars distinguish slots
+        coverages = sorted(r.scalars()[0] for r in results)
+        assert coverages == [10.0, 11.0, 12.0]
+        for r in results:
+            np.testing.assert_array_equal(
+                r.unpack_mask(),
+                ((np.arange(64).reshape(8, 8)
+                  + int(r.scalars()[0] - 10.0)) % 2).astype(np.uint8),
+            )
+        # the LAST release returns the staging buffer to the pool
+        assert sum(len(v) for v in d._egress_pool.values()) == 0
+        for r in results:
+            r.release()
+        assert sum(len(v) for v in d._egress_pool.values()) == 1
+        # released buffers are reused: a same-shape take returns the
+        # exact buffer instead of allocating
+        (shape,) = d._egress_pool
+        returned = d._egress_pool[shape][0]
+        buf = d._egress_take(shape)
+        assert buf is returned
+        assert sum(len(v) for v in d._egress_pool.values()) == 0
+        d._egress_put(buf)
+    finally:
+        d.stop()
+
+
+# -- wire parity (request side) ----------------------------------------------
+
+
+def test_legacy_request_bitwise_unchanged():
+    """mask_format=0 serializes to ZERO wire bytes (proto3 default): the
+    grown request is byte-identical to a pre-PR client's."""
+    rng = np.random.default_rng(3)
+    color = rng.integers(0, 255, (32, 32, 3)).astype(np.uint8)
+    depth = rng.integers(0, 5000, (32, 32)).astype(np.uint16)
+    legacy = client_lib.encode_request(color, depth)
+    explicit = client_lib.encode_request(color, depth, mask_format=0)
+    assert legacy.SerializeToString(deterministic=True) == \
+        explicit.SerializeToString(deterministic=True)
+    assert b"mask_format" not in legacy.SerializeToString()
+    packed = client_lib.encode_request(color, depth, mask_format=1)
+    assert packed.mask_format == 1
+
+
+# -- end to end --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def registered_model(tmp_path_factory):
+    from robotic_discovery_platform_tpu import tracking
+    from robotic_discovery_platform_tpu.models.unet import (
+        build_unet,
+        init_unet,
+    )
+    from robotic_discovery_platform_tpu.utils.config import ModelConfig
+
+    root = tmp_path_factory.mktemp("mlruns")
+    uri = f"file:{root}"
+    tracking.set_tracking_uri(uri)
+    tracking.set_experiment("Actuator Segmentation")
+    cfg = ModelConfig(base_features=8, compute_dtype="float32")
+    model = build_unet(cfg)
+    variables = init_unet(model, jax.random.key(0), img_size=64)
+    with tracking.start_run():
+        version = tracking.log_model(
+            variables, cfg, registered_model_name="Actuator-Segmenter"
+        )
+    tracking.Client().set_registered_model_alias(
+        "Actuator-Segmenter", "staging", version
+    )
+    return uri
+
+
+def _serve_stream(uri, tmp_path, reqs, tag, **cfg_kw):
+    import grpc
+
+    from robotic_discovery_platform_tpu.serving import server as server_lib
+    from robotic_discovery_platform_tpu.serving.proto import vision_grpc
+    from robotic_discovery_platform_tpu.utils.config import ServerConfig
+
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=uri,
+        model_img_size=64,
+        metrics_csv=str(tmp_path / f"metrics-{tag}.csv"),
+        calibration_path=str(tmp_path / "missing.npz"),
+        reload_poll_s=0.0,
+        **cfg_kw,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        channel = grpc.insecure_channel(f"localhost:{port}")
+        got = list(vision_grpc.VisionAnalysisServiceStub(channel)
+                   .AnalyzeActuatorPerformance(iter(reqs)))
+        channel.close()
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+    return got
+
+
+def _e2e_frames(n=3, w=64, h=64):
+    rng = np.random.default_rng(12)
+    out = []
+    for _ in range(n):
+        color = rng.integers(0, 255, (h, w, 3)).astype(np.uint8)
+        depth = rng.integers(0, 5000, (h, w)).astype(np.uint16)
+        depth[16:48, 16:48] = 1200  # a solid geometry patch
+        out.append((color, depth))
+    return out
+
+
+def test_server_packed_wire_roundtrip(registered_model, tmp_path):
+    """The acceptance gate end to end: bits and RLE responses decode to
+    the EXACT mask the legacy PNG leg carries, packed_spline reproduces
+    spline_points as exact f32 triples, and the legacy leg is bitwise
+    wire-identical with the encode pool on or off (proc_time_ms zeroed:
+    wall time differs run to run)."""
+    import cv2
+
+    frames = _e2e_frames()
+    by_fmt = {}
+    for mf in (0, 1, 2):
+        reqs = [client_lib.encode_request(c, d, fmt="raw", mask_format=mf)
+                for c, d in frames]
+        by_fmt[mf] = _serve_stream(registered_model, tmp_path, reqs,
+                                   f"mf{mf}")
+    legacy_pooled = _serve_stream(
+        registered_model, tmp_path,
+        [client_lib.encode_request(c, d, fmt="raw") for c, d in frames],
+        "mf0-pooled", egress_workers=2,
+    )
+    for i in range(len(frames)):
+        legacy, bits, rle = by_fmt[0][i], by_fmt[1][i], by_fmt[2][i]
+        for r in (legacy, bits, rle):
+            assert not r.status.startswith("ERROR"), r.status
+        # legacy leg: PNG bytes, Point3D splines, NO packed_spline
+        assert legacy.mask.startswith(b"\x89PNG")
+        assert not legacy.packed_spline
+        mask0 = cv2.imdecode(np.frombuffer(legacy.mask, np.uint8),
+                             cv2.IMREAD_GRAYSCALE) // 255
+        # packed legs decode to the exact same mask
+        for r in (bits, rle):
+            np.testing.assert_array_equal(
+                egress.decode_mask_wire(r.mask), mask0
+            )
+            assert not r.spline_points  # Point3D loop skipped
+            np.testing.assert_array_equal(
+                egress.decode_spline_wire(r.packed_spline),
+                np.array([[p.x, p.y, p.z]
+                          for p in legacy.spline_points],
+                         np.float32).reshape(-1, 3),
+            )
+            assert r.mean_curvature == legacy.mean_curvature
+            assert r.max_curvature == legacy.max_curvature
+            assert r.mask_coverage == legacy.mask_coverage
+        # encode-pool parity: workers=0 vs workers=2 byte-identical
+        a, b = legacy, legacy_pooled[i]
+        a.proc_time_ms = 0.0
+        b.proc_time_ms = 0.0
+        assert a.SerializeToString(deterministic=True) == \
+            b.SerializeToString(deterministic=True)
+
+
+def test_client_decodes_packed_stream(registered_model, tmp_path):
+    """run_client(mask_format=1): FrameResult.mask is the decoded exact
+    mask and the spline comes off packed_spline."""
+    import grpc
+
+    from robotic_discovery_platform_tpu.io.frames import SyntheticSource
+    from robotic_discovery_platform_tpu.serving import server as server_lib
+    from robotic_discovery_platform_tpu.utils.config import (
+        ClientConfig,
+        ServerConfig,
+    )
+
+    cfg = ServerConfig(
+        address="localhost:0",
+        tracking_uri=registered_model,
+        metrics_csv=str(tmp_path / "metrics.csv"),
+        calibration_path=str(tmp_path / "missing.npz"),
+        reload_poll_s=0.0,
+    )
+    server, servicer = server_lib.build_server(cfg)
+    port = server.add_insecure_port("localhost:0")
+    server.start()
+    try:
+        results = client_lib.run_client(
+            ClientConfig(server_address=f"localhost:{port}",
+                         calibration_path="none.npz"),
+            source=SyntheticSource(width=160, height=120, seed=1,
+                                   n_frames=3),
+            max_frames=3,
+            mask_format=egress.MASK_FORMAT_BITS,
+        )
+    finally:
+        server.stop(grace=None)
+        servicer.close()
+    assert len(results) == 3
+    for r in results:
+        assert r.mask is not None and r.mask.shape == (120, 160)
+        assert set(np.unique(r.mask)) <= {0, 1}
+        assert r.spline_points.shape[1:] == (3,)
+        if len(r.spline_points):  # rode packed_spline as exact f32
+            assert r.spline_points.dtype == np.float32

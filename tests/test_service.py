@@ -14,6 +14,7 @@ import pytest
 from robotic_discovery_platform_tpu import tracking
 from robotic_discovery_platform_tpu.io.frames import SyntheticSource
 from robotic_discovery_platform_tpu.serving import client as client_lib
+from robotic_discovery_platform_tpu.serving import egress as egress_lib
 from robotic_discovery_platform_tpu.serving import server as server_lib
 from robotic_discovery_platform_tpu.serving.metrics import HEADER, MetricsWriter
 from robotic_discovery_platform_tpu.serving.proto import vision_pb2
@@ -46,6 +47,19 @@ def registered_model(tmp_path_factory):
         "Actuator-Segmenter", "staging", version
     )
     return uri
+
+
+def _submit_analysis(dispatcher, rgb, depth, k, interval):
+    """dispatcher.submit normalized to a FrameAnalysis: server-built
+    analyzers end in the egress pack stage (PR 20), so the dispatcher
+    hands back a PackedResult row view -- to_analysis() is its exact
+    FrameAnalysis reconstruction."""
+    out = dispatcher.submit(rgb, depth, k, interval)
+    if isinstance(out, egress_lib.PackedResult):
+        analysis = out.to_analysis()
+        out.release()
+        return analysis
+    return out
 
 
 @pytest.fixture()
@@ -233,7 +247,7 @@ def test_batched_results_match_single_frame(batching_server, registered_model,
     source.stop()
     rgb = np.ascontiguousarray(color[..., ::-1])
     k = server_lib._default_intrinsics(160, 120).astype(np.float32)
-    batched = servicer.dispatcher.submit(rgb, depth, k, 0.001)
+    batched = _submit_analysis(servicer.dispatcher, rgb, depth, k, 0.001)
     single = servicer.analyze(
         servicer.variables, rgb, depth, k, np.float32(0.001)
     )
@@ -522,7 +536,7 @@ def test_hot_reload_with_batching_swaps_dispatcher(tmp_path):
         rgb = np.zeros((64, 64, 3), np.uint8)
         depth = np.full((64, 64), 900, np.uint16)
         k = server_lib._default_intrinsics(64, 64).astype(np.float32)
-        out1 = old_dispatcher.submit(rgb, depth, k, 0.001)
+        out1 = _submit_analysis(old_dispatcher, rgb, depth, k, 0.001)
         assert float(out1.mask_coverage) < 1.0  # bias -10 -> empty mask
 
         v2 = register(10.0)
@@ -534,10 +548,10 @@ def test_hot_reload_with_batching_swaps_dispatcher(tmp_path):
         # the grace window rather than hanging or erroring (probe it FIRST:
         # its graph is already compiled, so this stays well within the
         # grace period even on a loaded CI host)
-        out3 = old_dispatcher.submit(rgb, depth, k, 0.001)
+        out3 = _submit_analysis(old_dispatcher, rgb, depth, k, 0.001)
         assert float(out3.mask_coverage) < 1.0
         # new dispatcher serves the new model (pays its jit compile here)
-        out2 = new_dispatcher.submit(rgb, depth, k, 0.001)
+        out2 = _submit_analysis(new_dispatcher, rgb, depth, k, 0.001)
         assert float(out2.mask_coverage) > 99.0
         # and once stopped (drain-safe), a late submit raises cleanly
         old_dispatcher.stop()
@@ -583,7 +597,7 @@ def test_scan_batch_impl_serves(tmp_path):
         rgb[20:44] = 200  # a band the tiny model thresholds deterministically
         depth = np.full((64, 64), 900, np.uint16)
         k = server_lib._default_intrinsics(64, 64).astype(np.float32)
-        out = servicer.dispatcher.submit(rgb, depth, k, 0.001)
+        out = _submit_analysis(servicer.dispatcher, rgb, depth, k, 0.001)
         # equality anchor: the unbatched analyzer on the same frame
         single = servicer.analyze(
             servicer.variables, rgb, depth, k, np.float32(0.001)
@@ -687,7 +701,7 @@ def test_reload_grace_timer_does_not_block_close(tmp_path):
         rgb = np.zeros((64, 64, 3), np.uint8)
         depth = np.full((64, 64), 900, np.uint16)
         k = server_lib._default_intrinsics(64, 64).astype(np.float32)
-        out = servicer.dispatcher.submit(rgb, depth, k, 0.001)
+        out = _submit_analysis(servicer.dispatcher, rgb, depth, k, 0.001)
         assert float(out.mask_coverage) > 99.0
     finally:
         server.stop(grace=None)

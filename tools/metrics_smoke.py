@@ -54,6 +54,11 @@ REQUIRED_FAMILIES = (
     families.GEOMETRY_CACHE_HITS,
     families.GEOMETRY_CACHE_MISSES,
     families.HOST_STAGE_SPLIT,
+    # host-path egress (PR 20)
+    families.ENCODE_SECONDS,
+    families.EGRESS_BYTES,
+    families.EGRESS_QUEUE_DEPTH,
+    families.EGRESS_POOL_SIZE,
     # model zoo (PR 14)
     families.ZOO_MODELS,
     families.MODEL_DISPATCHES,
@@ -109,7 +114,12 @@ REQUIRED_SAMPLES = (
     # steady-state stream hits the geometry cache after its first frame
     f'{families.DECODE_SECONDS}_count{{format="encoded"}}',
     f'{families.HOST_STAGE_SPLIT}_count{{stage="decode"}}',
+    # host-path egress: every response mask encode is measured by format
+    # and the completer's packed fetch splits out the D2H leg
     f'{families.HOST_STAGE_SPLIT}_count{{stage="encode"}}',
+    f'{families.HOST_STAGE_SPLIT}_count{{stage="d2h"}}',
+    f'{families.ENCODE_SECONDS}_count{{format="png"}}',
+    f'{families.EGRESS_BYTES}{{format="png"}}',
     # the journal records readiness as a structured event on every boot
     f'{families.JOURNAL_EVENTS}{{kind="{events.SERVER_READY}"}}',
 )
@@ -183,6 +193,10 @@ def main() -> int:
         calibration_path=str(tmp / "missing.npz"),
         metrics_port=-1,  # RDP_METRICS_PORT (set by CI) overrides this
         slo_ms=250.0,  # SLO tracking on, so the rdp_slo_* families render
+        # micro-batching on, so the dispatcher completer's packed-egress
+        # fetch renders the stage="d2h" host-split sample
+        batch_window_ms=15.0,
+        max_batch=4,
     )
     server, servicer = server_lib.build_server(cfg)
     port = server.add_insecure_port("localhost:0")
